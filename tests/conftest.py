@@ -1,0 +1,37 @@
+"""Test env: force an 8-device virtual CPU mesh before jax backends initialize.
+
+This gives every test real multi-device semantics (sharding, collectives,
+resharding) without a pod — the distributed-testing tier the reference lacks
+entirely (SURVEY.md §4: "Distributed testing: none automated").
+
+NOTE: in this image jax is pre-imported at interpreter startup, so setting
+JAX_PLATFORMS via os.environ here is too late — the value is already baked
+into jax.config. jax.config.update still works because no backend has been
+initialized yet; XLA_FLAGS is read at backend init so it can still be set.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu():
+    assert jax.default_backend() == "cpu", jax.default_backend()

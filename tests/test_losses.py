@@ -1,0 +1,85 @@
+"""Loss unit tests (counterpart of reference ``tests/test_utils.py``)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.ops.losses import (
+    cross_entropy_loss,
+    next_token_loss,
+    token_log_likelihood,
+)
+
+
+def test_output_is_f32_even_for_bf16_logits():
+    # the reference's core dtype guarantee (reference losses.py:22, logs/580.md:94-106)
+    logits = jnp.zeros((4, 8, 16), jnp.bfloat16)
+    labels = jnp.zeros((4, 8), jnp.int32)
+    loss = cross_entropy_loss(logits, labels)
+    assert loss.dtype == jnp.float32
+
+
+def test_uniform_logits_golden_value():
+    vocab = 64
+    logits = jnp.zeros((2, 8, vocab))
+    labels = jnp.ones((2, 8), jnp.int32)
+    loss = cross_entropy_loss(logits, labels)
+    np.testing.assert_allclose(loss, np.log(vocab), rtol=1e-6)
+
+
+def test_matches_one_hot_formulation():
+    # numerical parity with the reference's one-hot matmul formulation
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(5, 7, 33)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 33, size=(5, 7)), jnp.int32)
+    ours = cross_entropy_loss(logits, labels)
+    one_hot = jax.nn.one_hot(labels, 33)
+    ref = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits.astype(jnp.float32)), -1))
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+
+def test_ignore_index_masks_padding():
+    logits = jnp.zeros((1, 4, 8))
+    labels = jnp.asarray([[1, 2, -1, -1]], jnp.int32)
+    # set a large logit at the ignored positions' labels — must not matter
+    masked = cross_entropy_loss(logits, jnp.where(labels == -1, 0, labels), ignore_index=None)
+    loss = cross_entropy_loss(logits, labels.clip(0), ignore_index=None)
+    np.testing.assert_allclose(masked, loss)
+    loss_ignored = cross_entropy_loss(logits, labels, ignore_index=-1)
+    np.testing.assert_allclose(loss_ignored, np.log(8), rtol=1e-6)
+
+
+def test_z_loss_adds_logz_penalty():
+    logits = jnp.ones((2, 3, 10)) * 2.0
+    labels = jnp.zeros((2, 3), jnp.int32)
+    base = cross_entropy_loss(logits, labels)
+    with_z = cross_entropy_loss(logits, labels, z_loss=1e-2)
+    lse = 2.0 + np.log(10)
+    np.testing.assert_allclose(with_z - base, 1e-2 * lse**2, rtol=1e-4)
+
+
+def test_next_token_loss_shifts():
+    vocab = 11
+    tokens = jnp.asarray([[3, 5, 7, 9]], jnp.int32)
+    # logits that put all mass on the correct next token -> loss ~ 0
+    logits = jax.nn.one_hot(jnp.asarray([[5, 7, 9, 0]], jnp.int32), vocab) * 100.0
+    loss = next_token_loss(logits, tokens)
+    assert loss < 1e-3
+
+
+def test_token_log_likelihood_greedy_flags():
+    vocab = 6
+    tokens = jnp.asarray([[1, 2, 3]], jnp.int32)
+    logits = jax.nn.one_hot(jnp.asarray([[2, 0, 5]], jnp.int32), vocab) * 10.0
+    ll, greedy = token_log_likelihood(logits, tokens)
+    assert ll.shape == (1, 2) and greedy.shape == (1, 2)
+    assert bool(greedy[0, 0]) is True  # predicted 2, target 2
+    assert bool(greedy[0, 1]) is False  # predicted 0, target 3
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.float16])
+def test_all_dtypes_finite(dtype):
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, 4, 32)) * 10, dtype)
+    labels = jnp.asarray(rng.integers(0, 32, size=(2, 4)), jnp.int32)
+    assert bool(jnp.isfinite(cross_entropy_loss(logits, labels)))

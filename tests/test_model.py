@@ -1,0 +1,171 @@
+"""Model unit tests (counterpart of reference ``tests/test_model_components.py``
+and ``tests/test_model_factory.py``, extended with GQA/RoPE/scan/decode)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import ModelConfig, model_config
+from zero_transformer_tpu.models import Transformer, model_getter
+from zero_transformer_tpu.ops.losses import next_token_loss
+from zero_transformer_tpu.ops.positions import alibi_slopes_list
+
+TEST_CFG = ModelConfig(
+    name="t", vocab_size=128, d_model=64, n_heads=4, n_layers=2, max_seq_len=32,
+    dropout=0.0, compute_dtype="float32",
+)
+
+
+def _init_and_apply(cfg, B=2, T=16, train=False, seed=0):
+    model = Transformer(cfg)
+    x = jnp.asarray(np.random.default_rng(seed).integers(0, cfg.vocab_size, (B, T)))
+    params = model.init(jax.random.PRNGKey(0), x)
+    rngs = {"dropout": jax.random.PRNGKey(1)} if train else {}
+    out = model.apply(params, x, train=train, rngs=rngs)
+    return model, params, x, out
+
+
+def test_forward_shapes():
+    _, _, x, logits = _init_and_apply(TEST_CFG)
+    assert logits.shape == (2, 16, TEST_CFG.vocab_size)
+
+
+def test_internal_loss_matches_external():
+    # reference pins this equality at tests/test_model_components.py:232-262
+    cfg = TEST_CFG
+    model = Transformer(cfg)
+    x = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)))
+    params = model.init(jax.random.PRNGKey(0), x)
+    logits, loss = model.apply(params, x, labels=x)
+    external = next_token_loss(logits, x)
+    np.testing.assert_allclose(loss, external, rtol=1e-6)
+
+
+@pytest.mark.parametrize("position", ["alibi", "rope", "learned"])
+def test_position_variants_forward(position):
+    cfg = dataclasses.replace(TEST_CFG, position=position)
+    _, _, _, logits = _init_and_apply(cfg)
+    assert logits.shape[-1] == cfg.vocab_size
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_alibi_extrapolates_beyond_train_length():
+    # ALiBi's point: run at T > the config the params were built for
+    cfg = TEST_CFG
+    model = Transformer(cfg)
+    x_short = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x_short)
+    x_long = jnp.zeros((1, 64), jnp.int32)  # 2x max_seq_len
+    logits = model.apply(params, x_long)
+    assert logits.shape == (1, 64, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_scan_and_loop_layers_match():
+    cfg_scan = dataclasses.replace(TEST_CFG, scan_layers=True)
+    cfg_loop = dataclasses.replace(TEST_CFG, scan_layers=False)
+    model_s = Transformer(cfg_scan)
+    model_l = Transformer(cfg_loop)
+    x = jnp.asarray(np.random.default_rng(0).integers(0, cfg_scan.vocab_size, (2, 8)))
+    ps = model_s.init(jax.random.PRNGKey(0), x)
+    # map scanned (stacked) params into per-layer params for the loop model
+    pl_struct = model_l.init(jax.random.PRNGKey(0), x)
+
+    def unstack(params_scan, template):
+        import flax.traverse_util as tu
+
+        fs = tu.flatten_dict(jax.tree.map(lambda x: x, params_scan["params"]))
+        ft = tu.flatten_dict(template["params"])
+        out = {}
+        for key in ft:
+            if key[0].startswith("block_"):
+                i = int(key[0].split("_")[1])
+                skey = ("blocks",) + key[1:]
+                out[key] = fs[skey][i]
+            else:
+                out[key] = fs[key]
+        return {"params": tu.unflatten_dict(out)}
+
+    # unwrap Partitioned boxes for arithmetic
+    import flax.linen as nn
+
+    ps_un = nn.meta.unbox(ps)
+    tmpl_un = nn.meta.unbox(pl_struct)
+    pl = unstack(ps_un, tmpl_un)
+    out_s = model_s.apply(ps_un, x)
+    out_l = model_l.apply(pl, x)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_l), atol=2e-5)
+
+
+def test_remat_matches_no_remat():
+    cfg = dataclasses.replace(TEST_CFG, remat=True)
+    model_r = Transformer(cfg)
+    model_n = Transformer(TEST_CFG)
+    x = jnp.zeros((1, 8), jnp.int32)
+    params = model_n.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(
+        np.asarray(model_r.apply(params, x)), np.asarray(model_n.apply(params, x)), atol=1e-6
+    )
+
+
+def test_gqa_llama_variant():
+    cfg = model_config("llama3_test", compute_dtype="float32")
+    _, _, _, logits = _init_and_apply(cfg, T=8)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_param_count_property_close_to_actual():
+    cfg = TEST_CFG
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert abs(actual - cfg.num_params) / actual < 0.02
+
+
+def test_dropout_active_only_in_train():
+    cfg = dataclasses.replace(TEST_CFG, dropout=0.5)
+    model = Transformer(cfg)
+    x = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    a = model.apply(params, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)})
+    b = model.apply(params, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+    c = model.apply(params, x)
+    d = model.apply(params, x)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(c), np.asarray(d))
+
+
+def test_alibi_slopes_power_of_two_and_not():
+    s8 = alibi_slopes_list(8)
+    np.testing.assert_allclose(s8, [2 ** (-i) for i in range(1, 9)], rtol=1e-6)
+    s6 = alibi_slopes_list(6)
+    assert len(s6) == 6 and all(s > 0 for s in s6)
+
+
+def test_factory_validates_names_and_dtypes():
+    with pytest.raises(ValueError):
+        model_getter("nope")
+    with pytest.raises(ValueError):
+        model_getter("test", dtype=jnp.int32)
+    model, cfg = model_getter("test", return_cfg=True, dtype=jnp.bfloat16)
+    assert cfg.compute_dtype == "bfloat16"
+    assert isinstance(model, Transformer)
+
+
+def test_every_param_has_sharding_metadata():
+    import flax.linen as nn
+
+    model = Transformer(TEST_CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    boxed = [
+        (path, leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+        )[0]
+    ]
+    assert boxed, "no params found"
+    for path, leaf in boxed:
+        assert isinstance(leaf, nn.Partitioned), f"{path} lacks partitioning metadata"

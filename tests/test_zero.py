@@ -1,0 +1,148 @@
+"""Distributed DP+ZeRO tests on the 8-device virtual CPU mesh.
+
+This is the tier the reference has zero automated coverage for (SURVEY §4):
+sharding spec derivation, ZeRO stage 0-3 training semantics, optimizer-state
+placement, and cross-stage numerical equivalence.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import MeshConfig, ModelConfig, OptimizerConfig
+from zero_transformer_tpu.models import Transformer
+from zero_transformer_tpu.parallel import (
+    DATA_AXIS,
+    TENSOR_AXIS,
+    make_mesh,
+    make_plan,
+    init_train_state,
+    make_train_step,
+    make_eval_step,
+)
+from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
+
+CFG = ModelConfig(
+    name="t", vocab_size=256, d_model=64, n_heads=4, n_layers=2, max_seq_len=32,
+    dropout=0.0, compute_dtype="float32",
+)
+OPT = OptimizerConfig(peak_learning_rate=1e-3, warmup_steps=4, total_steps=64)
+
+
+def _setup(mesh_cfg=MeshConfig(), zero_stage=1, model_cfg=CFG):
+    mesh = make_mesh(mesh_cfg)
+    model = Transformer(model_cfg)
+    tx = make_optimizer(OPT)
+    plan = make_plan(model, tx, mesh, (2, 16), zero_stage)
+    state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, (2, 16), plan)
+    step = make_train_step(model, tx, mesh, plan, zero_stage, make_schedule(OPT))
+    return mesh, model, plan, state, step
+
+
+def _batch(accum=1, bs=8, T=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, CFG.vocab_size, (accum, bs, T)), jnp.int32)
+
+
+def test_mesh_axes(devices):
+    mesh = make_mesh(MeshConfig())
+    assert mesh.shape[DATA_AXIS] == 8
+    mesh2 = make_mesh(MeshConfig(tensor=2))
+    assert mesh2.shape[DATA_AXIS] == 4 and mesh2.shape[TENSOR_AXIS] == 2
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=3))
+
+
+@pytest.mark.parametrize("zero_stage", [0, 1, 2, 3])
+def test_loss_decreases_all_stages(zero_stage):
+    mesh, model, plan, state, step = _setup(zero_stage=zero_stage)
+    rng = jax.random.PRNGKey(42)
+    losses = []
+    for i in range(20):
+        state, metrics = step(state, _batch(seed=0), rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.5, f"stage {zero_stage}: no learning: {losses}"
+
+
+def test_opt_state_sharded_8way_stage1():
+    mesh, model, plan, state, step = _setup(zero_stage=1)
+    # params replicated between steps (stage 1), optimizer mu sharded
+    leaves = jax.tree.leaves(state.params)
+    for leaf in leaves:
+        assert leaf.sharding.is_fully_replicated, leaf.sharding
+    # find a large opt leaf (mu of the mlp kernel) and check it is sharded
+    opt_leaves = [l for l in jax.tree.leaves(state.opt_state) if l.ndim >= 2]
+    sharded = [l for l in opt_leaves if not l.sharding.is_fully_replicated]
+    assert sharded, "no optimizer leaf is sharded under ZeRO-1"
+    big = max(sharded, key=lambda l: l.size)
+    assert len(big.sharding.device_set) == 8
+    # per-device bytes should be 1/8 of total
+    shard_size = big.addressable_shards[0].data.size
+    assert shard_size * 8 == big.size
+
+
+def test_params_sharded_stage3():
+    mesh, model, plan, state, step = _setup(zero_stage=3)
+    big = max(jax.tree.leaves(state.params), key=lambda l: l.size)
+    assert not big.sharding.is_fully_replicated
+    assert big.addressable_shards[0].data.size * 8 == big.size
+
+
+def test_stages_numerically_equivalent():
+    results = {}
+    for stage in [0, 1, 2, 3]:
+        mesh, model, plan, state, step = _setup(zero_stage=stage)
+        rng = jax.random.PRNGKey(7)
+        for i in range(3):
+            state, metrics = step(state, _batch(seed=i), rng)
+        results[stage] = float(metrics["loss"])
+    base = results[0]
+    for stage, loss in results.items():
+        np.testing.assert_allclose(loss, base, rtol=2e-4, err_msg=f"stage {stage}")
+
+
+def test_grad_accumulation_matches_large_batch():
+    mesh, model, plan, state, step = _setup(zero_stage=1)
+    big = _batch(accum=1, bs=16, seed=3)
+    split = big.reshape(2, 8, 16)  # [accum=2, 8, T]
+    state_a = state
+    state_b = jax.tree.map(jnp.copy, state)  # real copy: step() donates its input
+    rng = jax.random.PRNGKey(0)
+    state_a, ma = step(state_a, big, rng)
+    state_b, mb = step(state_b, split, rng)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_tensor_parallel_matches_dp():
+    mesh_tp, _, _, state_tp, step_tp = _setup(MeshConfig(tensor=2), zero_stage=1)
+    mesh_dp, _, _, state_dp, step_dp = _setup(MeshConfig(), zero_stage=1)
+    rng = jax.random.PRNGKey(1)
+    for i in range(3):
+        state_tp, mt = step_tp(state_tp, _batch(seed=i), rng)
+        state_dp, md = step_dp(state_dp, _batch(seed=i), rng)
+    np.testing.assert_allclose(float(mt["loss"]), float(md["loss"]), rtol=2e-4)
+    # TP actually shards a param over the tensor axis
+    any_tp = any(
+        TENSOR_AXIS in str(l.sharding.spec) for l in jax.tree.leaves(state_tp.params)
+    )
+    assert any_tp, "no param sharded over tensor axis"
+
+
+def test_eval_step():
+    mesh, model, plan, state, step = _setup()
+    eval_step = make_eval_step(model, mesh, plan)
+    loss = eval_step(state.params, _batch()[0])
+    assert jnp.isfinite(loss) and float(loss) > 0
+
+
+def test_train_step_donates_buffers():
+    mesh, model, plan, state, step = _setup()
+    old = state
+    state, _ = step(state, _batch(), jax.random.PRNGKey(0))
+    # donated input buffers are invalidated
+    with pytest.raises(RuntimeError):
+        _ = np.asarray(jax.tree.leaves(old.params)[0])

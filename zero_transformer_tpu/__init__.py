@@ -1,0 +1,23 @@
+"""zero_transformer_tpu — a TPU-native LLM pretraining + inference framework.
+
+A ground-up re-design of the capabilities of fattorib/ZeRO-transformer for the
+unified jax.Array era: NamedSharding ZeRO-1/2/3 on a device Mesh, a single
+fused jit train step, Pallas flash attention, ring-attention context
+parallelism, Orbax async checkpointing, and an in-tree JAX inference and eval
+path (no CUDA/PyTorch anywhere).
+"""
+
+__version__ = "0.1.0"
+
+from zero_transformer_tpu.config import (  # noqa: F401
+    CheckpointConfig,
+    Config,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    TrainingConfig,
+    load_config,
+    model_config,
+)
+from zero_transformer_tpu.models import Transformer, model_getter  # noqa: F401

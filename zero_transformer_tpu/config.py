@@ -1,0 +1,257 @@
+"""Typed configuration system.
+
+Replaces the reference's dual OmegaConf YAML zoos (reference:
+``conf/model_config.yaml`` + ``torch_compatability/model_config.yaml`` —
+duplicated per SURVEY.md §2) with a single typed dataclass hierarchy loaded
+from one YAML file. Everything the reference hardcoded in ``main_zero.py``
+(decay_steps at :211, shuffle seed :393, PRNGKey(0) :215, adam b2 :166,
+keep=5 :70) is a field here.
+"""
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import yaml
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def resolve_dtype(name: str):
+    if name not in _DTYPES:
+        raise ValueError(f"Invalid dtype {name!r}; expected one of {sorted(_DTYPES)}")
+    return _DTYPES[name]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters.
+
+    Covers the reference's GPT-2+ALiBi family (reference ``src/models/GPT.py:53-113``,
+    ``conf/model_config.yaml``) and extends it to the Llama family (RoPE, RMSNorm,
+    SwiGLU, GQA) via the ``position``, ``norm``, ``activation``, ``n_kv_heads`` axes.
+    """
+
+    name: str = "test"
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    max_seq_len: int = 32
+    dropout: float = 0.0
+    # "alibi" (train-short/test-long extrapolation, reference layers.py:17-44),
+    # "rope" (llama family), or "learned" (plain GPT-2).
+    position: str = "alibi"
+    rope_theta: float = 10000.0
+    n_kv_heads: Optional[int] = None  # GQA; None -> MHA
+    head_dim: Optional[int] = None  # None -> d_model // n_heads
+    d_ff: Optional[int] = None  # None -> 4*d_model (gelu) or 8/3*d_model (swiglu)
+    activation: str = "gelu"  # "gelu" | "swiglu"
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    tie_embeddings: bool = True
+    # Compilation shape: scan over layers gives O(1) compile time in depth and a
+    # stacked [n_layers, ...] param layout that ZeRO shards cleanly.
+    scan_layers: bool = True
+    remat: bool = False  # jax.checkpoint each block: trade FLOPs for HBM
+    attention_impl: str = "auto"  # "auto" | "xla" | "flash" (pallas)
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def head_width(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.activation == "swiglu":
+            # keep ~same params as 4x gelu: 2/3 * 4 * d, rounded to 128
+            return ((8 * self.d_model // 3) + 127) // 128 * 128
+        return 4 * self.d_model
+
+    @property
+    def num_params(self) -> int:
+        """Approximate parameter count (embedding included once when tied)."""
+        d, f, L, v = self.d_model, self.ff_dim, self.n_layers, self.vocab_size
+        h, kv, hd = self.n_heads, self.kv_heads, self.head_width
+        attn = d * h * hd + 2 * d * kv * hd + h * hd * d
+        mlp = (3 if self.activation == "swiglu" else 2) * d * f
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        embed = v * d * (1 if self.tie_embeddings else 2)
+        return L * per_layer + embed + d
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads and self.head_dim is None:
+            raise ValueError("d_model must be divisible by n_heads")
+        if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must be divisible by n_kv_heads")
+        if self.position not in ("alibi", "rope", "learned"):
+            raise ValueError(f"invalid position {self.position!r}")
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(f"invalid activation {self.activation!r}")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"invalid norm {self.norm!r}")
+        if self.attention_impl not in ("auto", "xla", "flash"):
+            raise ValueError(f"invalid attention_impl {self.attention_impl!r}")
+        resolve_dtype(self.param_dtype)
+        resolve_dtype(self.compute_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout. Axes: data (DP+ZeRO), fsdp (param shard for ZeRO-3),
+    tensor (Megatron TP), sequence (ring-attention context parallelism).
+
+    The reference uses a 1-D ``("dp",)`` mesh only (reference ``main_zero.py:227-228``).
+    """
+
+    data: int = -1  # -1: use all remaining devices
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    # ZeRO stage: 0 = plain DP, 1 = opt-state sharded, 2 = +grad reduce-scatter,
+    # 3 = +param sharded (FSDP). Reference implements stage 1 only (SURVEY §2).
+    zero_stage: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    peak_learning_rate: float = 3e-4
+    end_learning_rate: float = 3e-5
+    warmup_steps: int = 2000
+    decay_steps: Optional[int] = None  # None -> total_steps - warmup_steps
+    total_steps: int = 163000
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    grad_clip: float = 1.0
+    schedule: str = "warmup_cosine"  # "warmup_cosine" | "warmup_linear" | "constant"
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingConfig:
+    batch_size: int = 256  # global batch, in sequences
+    gradient_accumulation_steps: int = 1
+    train_context: int = 1024
+    evaluation_frequency: int = 1000
+    maximum_evaluation_steps: int = 250
+    total_steps: int = 163000
+    seed: int = 0
+    log_frequency: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    # "synthetic" | "memmap" | "hf" (datasets streaming)
+    source: str = "synthetic"
+    train_path: str = ""
+    validation_path: str = ""
+    max_context: int = 2048
+    shuffle_buffer: int = 10_000
+    shuffle_seed: int = 23
+    num_workers: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str = "checkpoints"
+    keep: int = 5
+    save_frequency: int = 1000
+    async_save: bool = True
+    resume: bool = False
+    warm_init: bool = False
+    warm_init_dir: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    training: TrainingConfig = dataclasses.field(default_factory=TrainingConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+
+def _build(cls, raw: dict) -> Any:
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(raw) - set(fields)
+    if unknown:
+        raise ValueError(f"Unknown keys for {cls.__name__}: {sorted(unknown)}")
+    return cls(**raw)
+
+
+_MODEL_ZOO_PATH = Path(__file__).resolve().parent.parent / "configs" / "models.yaml"
+
+
+def load_model_zoo(path: str | Path = _MODEL_ZOO_PATH) -> dict[str, ModelConfig]:
+    with open(path) as f:
+        raw = yaml.safe_load(f)
+    return {name: _build(ModelConfig, {"name": name, **(body or {})}) for name, body in raw.items()}
+
+
+def model_config(name: str, path: str | Path = _MODEL_ZOO_PATH, **overrides) -> ModelConfig:
+    """Look up a model by zoo name (reference ``model_getter``, GPT.py:116-137)."""
+    zoo = load_model_zoo(path)
+    if name not in zoo:
+        raise ValueError(f"Invalid model name {name!r}; expected one of {sorted(zoo)}")
+    cfg = zoo[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def load_config(path: str | Path, **overrides) -> Config:
+    """Load a full training Config from YAML.
+
+    The ``model`` section may be either an inline mapping or ``{"size": <zoo name>}``
+    (mirroring the reference's ``model.size`` lookup, ``conf/config.yaml:14``).
+    """
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    raw.update(overrides)
+    sections = {}
+    model_raw = dict(raw.pop("model", {}) or {})
+    if "size" in model_raw:
+        size = model_raw.pop("size")
+        base = model_config(size)
+        valid = {f.name for f in dataclasses.fields(ModelConfig)}
+        unknown = set(model_raw) - valid
+        if unknown:
+            raise ValueError(f"Unknown keys for ModelConfig: {sorted(unknown)}")
+        sections["model"] = dataclasses.replace(base, **model_raw)
+    elif model_raw:
+        sections["model"] = _build(ModelConfig, model_raw)
+    for key, cls in (
+        ("mesh", MeshConfig),
+        ("optimizer", OptimizerConfig),
+        ("training", TrainingConfig),
+        ("data", DataConfig),
+        ("checkpoint", CheckpointConfig),
+    ):
+        if key in raw:
+            sections[key] = _build(cls, raw.pop(key) or {})
+    if raw:
+        raise ValueError(f"Unknown top-level config keys: {sorted(raw)}")
+    return Config(**sections)
+
+
+def flatten_config(cfg: Config) -> dict[str, Any]:
+    """Flatten for metric loggers (reference ``src/utils/configs.py:7-17``)."""
+    out = {}
+    for section in dataclasses.fields(cfg):
+        val = getattr(cfg, section.name)
+        for f in dataclasses.fields(val):
+            out[f"{section.name}.{f.name}"] = getattr(val, f.name)
+    return out

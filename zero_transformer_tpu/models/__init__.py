@@ -1,0 +1,2 @@
+from zero_transformer_tpu.models.gpt import Attention, Block, MLP, Transformer  # noqa: F401
+from zero_transformer_tpu.models.registry import model_getter  # noqa: F401

@@ -1,0 +1,258 @@
+"""Decoder-only transformer LM, TPU-first.
+
+Covers the reference's GPT-2+ALiBi family (reference ``src/models/GPT.py``,
+``src/models/layers.py``) and the Llama family (RoPE/RMSNorm/SwiGLU/GQA) from
+one module tree, with:
+
+- logical-axis sharding metadata on every parameter (``nn.with_partitioning``),
+  which the reference only gestured at (reference ``layers.py:13-14``, unused);
+- optional ``nn.scan`` over layers → O(1) compile time in depth and stacked
+  [n_layers, ...] params that ZeRO shards cleanly;
+- optional ``nn.remat`` per block (rematerialization: FLOPs for HBM);
+- a fixed-shape jit-able KV-cache decode path — the capability the reference
+  only has on its CUDA side (reference ``torch_compatability/GPT2.py:175-245``);
+- float32 softmax and residual-projection init std 0.02/sqrt(2N) preserved
+  (reference ``layers.py:72,184,167-173``).
+
+API kept reference-compatible: ``Transformer.__call__(x, labels=None, train=False)``
+returns logits or (logits, loss) (reference ``GPT.py:67-113``).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers
+
+from zero_transformer_tpu.config import ModelConfig, resolve_dtype
+from zero_transformer_tpu.ops.attention import dot_product_attention, xla_attention
+from zero_transformer_tpu.ops.losses import next_token_loss
+from zero_transformer_tpu.ops.positions import apply_rope
+
+Dtype = Any
+
+
+def _dense(features: int, axes: Tuple, std: float, dtype, param_dtype, name: str):
+    return nn.Dense(
+        features,
+        use_bias=False,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        kernel_init=nn.with_partitioning(initializers.normal(stddev=std), axes),
+        name=name,
+    )
+
+
+def _norm(cfg: ModelConfig, dtype, name: str):
+    kwargs = dict(
+        dtype=dtype,
+        param_dtype=resolve_dtype(cfg.param_dtype),
+        scale_init=nn.with_partitioning(initializers.ones, ("embed",)),
+        name=name,
+    )
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(**kwargs)
+    return nn.LayerNorm(use_bias=False, **kwargs)
+
+
+class Attention(nn.Module):
+    """Causal MHA/GQA with ALiBi or RoPE and a fixed-shape KV cache."""
+
+    cfg: ModelConfig
+    deterministic: bool = True
+    decode: bool = False
+    cache_len: Optional[int] = None  # KV cache capacity; defaults to cfg.max_seq_len
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dtype = x.dtype
+        param_dtype = resolve_dtype(cfg.param_dtype)
+        H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_width
+        B, T, _ = x.shape
+        resid_std = 0.02 / (2 * cfg.n_layers) ** 0.5
+
+        q = _dense(H * D, ("embed", "qheads"), 0.02, dtype, param_dtype, "query")(x)
+        k = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "key")(x)
+        v = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "value")(x)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, KVH, D)
+        v = v.reshape(B, T, KVH, D)
+
+        use_cache = False
+        offset = 0
+        if self.decode:
+            max_len = self.cache_len or cfg.max_seq_len
+            is_init = not self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key", jnp.zeros, (B, max_len, KVH, D), dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, (B, max_len, KVH, D), dtype)
+            idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            use_cache = not is_init
+            if use_cache:
+                offset = idx.value
+
+        if cfg.position == "rope":
+            pos = offset + jnp.arange(T, dtype=jnp.int32)
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)  # cache stores rotated keys
+
+        if use_cache:
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+            idx.value = offset + T
+            kv_valid = (jnp.arange(ck.value.shape[1]) < offset + T).astype(jnp.int32)
+            # Writing past capacity would silently clamp onto the last slot
+            # (dynamic_update_slice semantics). Poison the output with NaN
+            # instead so overflow is loud even under jit; generate() also
+            # guards statically.
+            overflow = offset + T > ck.value.shape[1]
+            q = jnp.where(overflow, jnp.nan, 1.0).astype(q.dtype) * q
+            out = xla_attention(
+                q,
+                ck.value,
+                cv.value,
+                causal=T > 1,
+                alibi=cfg.position == "alibi",
+                q_offset=offset,
+                segment_ids=jnp.broadcast_to(kv_valid[None, :], (B, ck.value.shape[1])),
+            )
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=True, alibi=cfg.position == "alibi", impl=cfg.attention_impl
+            )
+
+        out = out.reshape(B, T, H * D)
+        out = _dense(cfg.d_model, ("qheads", "embed"), resid_std, dtype, param_dtype, "out")(out)
+        return nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
+
+
+class MLP(nn.Module):
+    cfg: ModelConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dtype = x.dtype
+        param_dtype = resolve_dtype(cfg.param_dtype)
+        resid_std = 0.02 / (2 * cfg.n_layers) ** 0.5
+        f = cfg.ff_dim
+        h = _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "wi")(x)
+        if cfg.activation == "swiglu":
+            g = _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "gate")(x)
+            h = nn.silu(g) * h
+        else:
+            h = nn.gelu(h)
+        out = _dense(cfg.d_model, ("mlp", "embed"), resid_std, dtype, param_dtype, "wo")(h)
+        return nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block (reference ``GPT.py:16-50``)."""
+
+    cfg: ModelConfig
+    deterministic: bool = True
+    decode: bool = False
+    cache_len: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, _=None):
+        cfg = self.cfg
+        x = x + Attention(cfg, self.deterministic, self.decode, self.cache_len, name="attn")(
+            _norm(cfg, x.dtype, "ln_attn")(x)
+        )
+        x = x + MLP(cfg, self.deterministic, name="mlp")(
+            _norm(cfg, x.dtype, "ln_mlp")(x)
+        )
+        return x, None
+
+
+class Transformer(nn.Module):
+    """Full decoder LM. ``decode=True`` builds the KV-cache variant."""
+
+    cfg: ModelConfig
+    decode: bool = False
+    cache_len: Optional[int] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        labels: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+        cfg = self.cfg
+        dtype = resolve_dtype(cfg.compute_dtype)
+        param_dtype = resolve_dtype(cfg.param_dtype)
+        B, T = x.shape
+
+        embed = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.d_model,
+            embedding_init=nn.with_partitioning(
+                initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            dtype=dtype,
+            param_dtype=param_dtype,
+            name="wte",
+        )
+        h = embed(x)
+
+        if cfg.position == "learned":
+            if T > cfg.max_seq_len:
+                raise ValueError(
+                    f"sequence length {T} > max_seq_len {cfg.max_seq_len}: learned "
+                    "positions cannot extrapolate (use position='alibi' for that)"
+                )
+            wpe = nn.Embed(
+                num_embeddings=cfg.max_seq_len,
+                features=cfg.d_model,
+                embedding_init=nn.with_partitioning(
+                    initializers.normal(stddev=0.02), (None, "embed")
+                ),
+                dtype=dtype,
+                param_dtype=param_dtype,
+                name="wpe",
+            )
+            offset = 0
+            if self.decode:
+                is_init = not self.has_variable("cache", "decode_pos")
+                pos_var = self.variable("cache", "decode_pos", lambda: jnp.zeros((), jnp.int32))
+                if not is_init:
+                    offset = pos_var.value
+                    pos_var.value = offset + T
+            positions = offset + jnp.arange(T, dtype=jnp.int32)
+            h = h + wpe(positions)
+
+        h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, not train, self.decode, self.cache_len, name="blocks")
+            h, _ = stack(h, None)
+        else:
+            for i in range(cfg.n_layers):
+                h, _ = block_cls(cfg, not train, self.decode, self.cache_len, name=f"block_{i}")(h, None)
+
+        h = _norm(cfg, h.dtype, "ln_f")(h)
+
+        if cfg.tie_embeddings:
+            logits = embed.attend(h)
+        else:
+            logits = _dense(
+                cfg.vocab_size, ("embed", "vocab"), 0.02, dtype, param_dtype, "lm_head"
+            )(h)
+
+        if labels is None:
+            return logits
+        return logits, next_token_loss(logits, labels)

@@ -1,0 +1,36 @@
+"""Model factory (reference ``src/models/GPT.py:116-137`` ``model_getter``)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from zero_transformer_tpu.config import _DTYPES, ModelConfig, model_config
+from zero_transformer_tpu.models.gpt import Transformer
+
+_DTYPE_NAMES = {v: k for k, v in _DTYPES.items()}
+
+
+def model_getter(
+    model_size: str,
+    config_path: Optional[str] = None,
+    return_cfg: bool = False,
+    dtype=jnp.float32,
+    decode: bool = False,
+    **overrides,
+) -> Union[Transformer, Tuple[Transformer, ModelConfig]]:
+    """Build a Transformer from the zoo by name.
+
+    ``dtype`` sets the compute dtype (params are always kept in
+    ``param_dtype``, float32 by default — the master-weight discipline the
+    reference implements with an explicit bf16 cast, reference
+    ``src/partitioning/xmap_train_functions.py:13-16``).
+    """
+    if dtype not in _DTYPE_NAMES:
+        raise ValueError(f"Invalid dtype provided: {dtype}")
+    kwargs = {"path": config_path} if config_path else {}
+    cfg = model_config(model_size, **kwargs)
+    cfg = dataclasses.replace(cfg, compute_dtype=_DTYPE_NAMES[dtype], **overrides)
+    model = Transformer(cfg, decode=decode)
+    return (model, cfg) if return_cfg else model

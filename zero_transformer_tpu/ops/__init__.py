@@ -1,0 +1,7 @@
+from zero_transformer_tpu.ops.attention import dot_product_attention, xla_attention  # noqa: F401
+from zero_transformer_tpu.ops.losses import (  # noqa: F401
+    cross_entropy_loss,
+    next_token_loss,
+    token_log_likelihood,
+)
+from zero_transformer_tpu.ops.positions import alibi_bias, alibi_slopes, apply_rope  # noqa: F401

@@ -1,0 +1,102 @@
+"""Attention ops.
+
+The XLA path below is the always-correct reference implementation: causal
+multi-head/grouped-query attention with an additive bias (ALiBi) and a
+float32 softmax — the dtype discipline the reference learned the hard way
+(reference ``src/models/layers.py:167-173``; bug log ``logs/580.md:94-98``).
+
+``dot_product_attention`` dispatches between this and the Pallas flash kernel
+(``zero_transformer_tpu.ops.flash_attention``) which never materializes the
+[T, T] score matrix the reference allocates in full (reference ``layers.py:159-173``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from zero_transformer_tpu.ops.positions import NEG_INF, alibi_bias, causal_mask_bias
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    alibi: bool = False,
+    q_offset=0,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention via explicit einsums, softmax in float32.
+
+    Args:
+      q: [B, Tq, H, D]
+      k, v: [B, Tkv, KVH, D]; KVH must divide H (GQA).
+      q_offset: position of q[0] within the full sequence (decode w/ KV cache).
+        May be a traced scalar.
+      segment_ids: optional [B, Tkv] int mask; 0 = padding (masked out).
+    """
+    B, Tq, H, D = q.shape
+    _, Tkv, KVH, _ = k.shape
+    G = H // KVH
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
+
+    qg = q.reshape(B, Tq, KVH, G, D)
+    # scores in f32
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * jnp.float32(scale)
+
+    if alibi:
+        bias = alibi_bias(H, Tq, Tkv, offset=q_offset)  # [H, Tq, Tkv]
+        if causal:
+            bias = bias + causal_mask_bias(Tq, Tkv, offset=q_offset)[None]
+        scores = scores + bias.reshape(1, KVH, G, Tq, Tkv)
+    elif causal:
+        scores = scores + causal_mask_bias(Tq, Tkv, offset=q_offset)[None, None, None]
+    if segment_ids is not None:
+        pad = jnp.where(segment_ids[:, None, None, None, :] != 0, 0.0, NEG_INF)
+        scores = scores + pad
+
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", weights, v)
+    return out.reshape(B, Tq, H, D)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    alibi: bool = False,
+    q_offset=0,
+    segment_ids: Optional[jax.Array] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching attention entry point used by the models.
+
+    impl="auto" picks the Pallas flash kernel on TPU for full-sequence causal
+    training shapes and falls back to the XLA path everywhere else (decode,
+    CPU tests, odd shapes).
+    """
+    if impl in ("auto", "flash"):
+        from zero_transformer_tpu.ops import flash_attention as fa
+
+        if fa.supported(
+            q, k, v, causal=causal, alibi=alibi, q_offset=q_offset, segment_ids=segment_ids
+        ):
+            try:
+                return fa.flash_attention(q, k, v, causal=causal, alibi=alibi)
+            except NotImplementedError:
+                if impl == "flash":
+                    raise
+        elif impl == "flash":
+            raise NotImplementedError(
+                f"flash attention unsupported for shapes q={q.shape} k={k.shape}"
+            )
+    return xla_attention(
+        q, k, v, causal=causal, alibi=alibi, q_offset=q_offset, segment_ids=segment_ids
+    )

@@ -1,0 +1,23 @@
+"""Pallas TPU flash attention (placeholder gate — kernel lands in ops/pallas/).
+
+Until the kernel is wired in, ``supported`` returns False so the dispatcher in
+``ops.attention`` always takes the XLA path. This keeps a single call site for
+the hot op while the Pallas implementation matures.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def supported(q, k, v, *, causal: bool, alibi: bool = False, q_offset=0, segment_ids=None) -> bool:
+    # q_offset must be a static 0 (full-sequence training shapes): the kernel
+    # has no offset plumbing, so a decode-style call must take the XLA path.
+    if not (isinstance(q_offset, int) and q_offset == 0):
+        return False
+    if segment_ids is not None:
+        return False
+    return False  # kernel not wired in yet
+
+
+def flash_attention(q, k, v, *, causal: bool = True, alibi: bool = False) -> jax.Array:
+    raise NotImplementedError("pallas flash attention not wired in yet")

@@ -1,0 +1,77 @@
+"""Loss functions.
+
+TPU-native cross entropy: integer labels + ``take_along_axis`` instead of the
+reference's materialized one-hot matmul (reference ``src/utils/losses.py:9-23``
+builds a [B*T, vocab] one-hot — 50304x the label memory). The log-softmax is
+computed in float32 regardless of input dtype, preserving the reference's
+bf16-safety guarantee (reference ``losses.py:22``; bug history ``logs/580.md:94-106``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: Optional[int] = None,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """Mean token-level cross entropy, computed in float32.
+
+    Args:
+      logits: [..., vocab] in any float dtype.
+      labels: [...] int token ids.
+      ignore_index: label value to mask out of the mean (e.g. padding).
+      z_loss: coefficient for the PaLM-style log-Z regularizer (stabilizes
+        logits in bf16 training; 0 disables).
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logits
+    if z_loss:
+        nll = nll + z_loss * jnp.square(lse)
+    if ignore_index is not None:
+        mask = (labels != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def next_token_loss(
+    logits: jax.Array,
+    tokens: jax.Array,
+    ignore_index: Optional[int] = None,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """Shifted LM loss: predict tokens[t+1] from logits[t].
+
+    Matches the reference's in-model shift (reference ``GPT.py:102-113``).
+
+    Args:
+      logits: [..., T, vocab].
+      tokens: [..., T] int ids (same sequence that produced the logits).
+    """
+    return cross_entropy_loss(
+        logits[..., :-1, :], tokens[..., 1:], ignore_index=ignore_index, z_loss=z_loss
+    )
+
+
+def token_log_likelihood(logits: jax.Array, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-position log p(tokens[t+1] | tokens[<=t]) and greedy-match flags.
+
+    Used by the eval harness (LAMBADA PPL/ACC — replaces the reference's
+    GPU-side lm-eval-harness path, SURVEY §6).
+
+    Returns:
+      (logprobs [..., T-1], is_greedy [..., T-1] bool)
+    """
+    logits = logits[..., :-1, :].astype(jnp.float32)
+    targets = tokens[..., 1:]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    is_greedy = jnp.argmax(logits, axis=-1) == targets
+    return ll, is_greedy

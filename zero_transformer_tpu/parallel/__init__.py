@@ -1,0 +1,17 @@
+from zero_transformer_tpu.parallel.mesh import (  # noqa: F401
+    AXES,
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+    make_mesh,
+    single_device_mesh,
+)
+from zero_transformer_tpu.parallel.zero import (  # noqa: F401
+    ShardingPlan,
+    TrainState,
+    init_train_state,
+    make_eval_step,
+    make_plan,
+    make_train_step,
+)

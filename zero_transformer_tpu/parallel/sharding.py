@@ -1,0 +1,182 @@
+"""Sharding spec derivation: logical axes → NamedSharding, plus ZeRO layouts.
+
+Replaces the reference's regex rule engine (reference
+``src/partitioning/partition.py:28-111``: path-regex → PartitionSpec, with a
+runtime assert that every param matched) with two composable, *total* passes:
+
+1. **Tensor-parallel specs** from the logical axis names each param was
+   annotated with in the model (``nn.with_partitioning``) via a rules table —
+   the idiomatic flax ``logical_to_mesh`` design.
+2. **ZeRO sharding** (stages 1-3) derived from *shapes*: for each tensor,
+   shard the largest not-yet-sharded dimension divisible by the ZeRO axis
+   size. This is what the reference's regex table effectively encodes by hand
+   (``partition.py:49-87``), but it cannot miss a param and extends to any
+   model family unchanged.
+
+Optimizer-state specs clone each param's spec onto same-shaped leaves and
+replicate the rest — the reference's ``create_opt_spec`` (``partition.py:114-140``)
+without the optax-internals coupling.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zero_transformer_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+    zero_axes,
+)
+
+# logical axis name -> mesh axis (None = replicated). Megatron layout:
+# qkv/mlp-in sharded on the output feature axis, out-proj/mlp-out on input.
+LOGICAL_RULES: dict[str, Optional[str]] = {
+    "vocab": TENSOR_AXIS,
+    "qheads": TENSOR_AXIS,
+    "kvheads": TENSOR_AXIS,
+    "mlp": TENSOR_AXIS,
+    "embed": None,
+    "layers": None,
+}
+
+
+def logical_specs(boxed_params) -> Any:
+    """Pytree of PartitionSpec(logical axis names) from nn.Partitioned boxes."""
+    return nn.get_partition_spec(boxed_params)
+
+
+def unbox(boxed_params) -> Any:
+    return nn.meta.unbox(boxed_params)
+
+
+def _tp_axes(logical: P, mesh: Mesh) -> tuple:
+    """Map one param's logical spec to mesh axes via LOGICAL_RULES."""
+    out = []
+    for name in logical:
+        if name is None:
+            out.append(None)
+            continue
+        axis = LOGICAL_RULES.get(name)
+        if axis is not None and mesh.shape.get(axis, 1) > 1:
+            out.append(axis)
+        else:
+            out.append(None)
+    return tuple(out)
+
+
+def _add_zero_axis(shape: tuple, tp: tuple, mesh: Mesh, axes: tuple[str, ...]) -> tuple:
+    """Shard the largest unsharded dim divisible by the ZeRO world size."""
+    size = math.prod(mesh.shape[a] for a in axes)
+    if size <= 1:
+        return tp
+    tp = tuple(tp) + (None,) * (len(shape) - len(tp))
+    best, best_dim = -1, None
+    for i, (d, t) in enumerate(zip(shape, tp)):
+        if t is not None:
+            continue
+        # remaining dim must divide by zero size (after any TP split on other dims)
+        if d % size == 0 and d > best:
+            best, best_dim = d, i
+    if best_dim is None:
+        return tp  # too small / indivisible: stays replicated (never an error)
+    out = list(tp)
+    out[best_dim] = axes if len(axes) > 1 else axes[0]
+    return tuple(out)
+
+
+def param_sharding(
+    mesh: Mesh,
+    abstract_params: Any,
+    logical: Any,
+    zero_stage: int = 1,
+) -> Any:
+    """NamedSharding pytree for the *stored* master params.
+
+    Stage 0-2: TP axes only (params replicated over data/fsdp between steps —
+    reference behavior, ``main_zero.py:455,500``). Stage 3: + ZeRO axis (FSDP).
+    """
+    zaxes = zero_axes(mesh)
+
+    def one(leaf, spec):
+        tp = _tp_axes(spec, mesh)
+        if zero_stage >= 3:
+            tp = _add_zero_axis(leaf.shape, tp, mesh, zaxes)
+        return NamedSharding(mesh, P(*tp))
+
+    return jax.tree.map(one, abstract_params, logical)
+
+
+def zero_sharding(mesh: Mesh, abstract_params: Any, logical: Any) -> Any:
+    """Fully ZeRO-sharded specs (TP + ZeRO axis) — the layout for optimizer
+    state (stage≥1), gradient reduce-scatter targets (stage≥2), and stage-3
+    params. Counterpart of reference ``set_partitions_zero`` (``partition.py:90-111``)."""
+    zaxes = zero_axes(mesh)
+
+    def one(leaf, spec):
+        tp = _tp_axes(spec, mesh)
+        tp = _add_zero_axis(leaf.shape, tp, mesh, zaxes)
+        return NamedSharding(mesh, P(*tp))
+
+    return jax.tree.map(one, abstract_params, logical)
+
+
+def opt_state_sharding(
+    mesh: Mesh, abstract_opt_state: Any, abstract_params: Any, param_zero_specs: Any
+) -> Any:
+    """Clone each param's ZeRO spec onto param-structured optimizer subtrees.
+
+    Works on ``jax.eval_shape(tx.init, params)`` output. The opt state is
+    walked top-down: any subtree whose treedef equals the param treedef (Adam
+    mu/nu, Adafactor rows, …) is substituted with the param specs leaf-for-leaf;
+    everything else (counts, masked sentinels) is replicated. Structural
+    matching — not shape matching — so two distinct params that happen to share
+    a shape can never steal each other's (possibly transposed) spec.
+    (Reference: ``create_opt_spec``, ``partition.py:114-140``.)
+    """
+    pstruct = jax.tree.structure(abstract_params)
+    pshapes = [p.shape for p in jax.tree.leaves(abstract_params)]
+    replicated = NamedSharding(mesh, P())
+
+    def is_param_tree(x) -> bool:
+        return jax.tree.structure(x) == pstruct and [
+            l.shape for l in jax.tree.leaves(x)
+        ] == pshapes
+
+    leaves, treedef = jax.tree_util.tree_flatten(abstract_opt_state, is_leaf=is_param_tree)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            jax.tree.map(lambda _, s: s, leaf, param_zero_specs)
+            if is_param_tree(leaf)
+            else replicated
+            for leaf in leaves
+        ],
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[batch, seq] input sharding: batch over data(+fsdp), seq over sequence."""
+    batch_axes = tuple(
+        a for a in (DATA_AXIS, FSDP_AXIS) if mesh.shape.get(a, 1) > 1
+    ) or (DATA_AXIS,)
+    seq_axis = SEQUENCE_AXIS if mesh.shape.get(SEQUENCE_AXIS, 1) > 1 else None
+    return NamedSharding(mesh, P(batch_axes, seq_axis))
+
+
+def activation_sharding(mesh: Mesh) -> NamedSharding:
+    """[batch, seq, embed] activation layout."""
+    batch_axes = tuple(
+        a for a in (DATA_AXIS, FSDP_AXIS) if mesh.shape.get(a, 1) > 1
+    ) or (DATA_AXIS,)
+    seq_axis = SEQUENCE_AXIS if mesh.shape.get(SEQUENCE_AXIS, 1) > 1 else None
+    return NamedSharding(mesh, P(batch_axes, seq_axis, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,75 @@
+"""Optimizer + LR schedule factory.
+
+Reference equivalent: ``main_zero.py:142-173`` (AdamW chain with clip-by-global
+-norm and a weight-decay mask) and ``:207-213`` (warmup-cosine schedule with a
+hardcoded decay horizon). Here every knob is config, and the weight-decay mask
+is *path-based* (decay kernels/embeddings, skip norm scales and positional
+embeddings) instead of ndim-based — the reference's ``ndim != 1`` test
+(``main_zero.py:155-158``) breaks under scan-stacked layers where norm scales
+are [n_layers, d].
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.traverse_util as traverse_util
+import optax
+
+from zero_transformer_tpu.config import OptimizerConfig
+
+
+def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
+    if cfg.schedule == "constant":
+        return optax.constant_schedule(cfg.peak_learning_rate)
+    decay_steps = cfg.decay_steps if cfg.decay_steps is not None else (
+        cfg.total_steps - cfg.warmup_steps
+    )
+    if cfg.schedule == "warmup_linear":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, cfg.peak_learning_rate, cfg.warmup_steps),
+                optax.linear_schedule(cfg.peak_learning_rate, cfg.end_learning_rate, decay_steps),
+            ],
+            [cfg.warmup_steps],
+        )
+    if cfg.schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.peak_learning_rate,
+            warmup_steps=cfg.warmup_steps,
+            # reference hardcodes decay_steps=143000 (main_zero.py:211)
+            decay_steps=cfg.warmup_steps + decay_steps,
+            end_value=cfg.end_learning_rate,
+        )
+    raise ValueError(f"unknown schedule {cfg.schedule!r}")
+
+
+def weight_decay_mask(params: Any) -> Any:
+    """True (decay) for kernels and the token embedding; False for norm scales,
+    biases, and positional embeddings."""
+    flat = traverse_util.flatten_dict(params, sep="/")
+
+    def decay(path: str) -> bool:
+        if "wpe" in path:
+            return False
+        leaf = path.rsplit("/", 1)[-1]
+        return leaf in ("kernel", "embedding")
+
+    return traverse_util.unflatten_dict(
+        {tuple(k.split("/")): decay(k) for k in flat}, sep=None
+    )
+
+
+def make_optimizer(cfg: OptimizerConfig, schedule=None) -> optax.GradientTransformation:
+    schedule = schedule or make_schedule(cfg)
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(
+            learning_rate=schedule,
+            b1=cfg.b1,
+            b2=cfg.b2,
+            eps=cfg.eps,
+            weight_decay=cfg.weight_decay,
+            mask=weight_decay_mask,
+        ),
+    )
