@@ -1,0 +1,83 @@
+"""Benchmark: training throughput (tokens/sec/chip) on the reference's 580M config.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference trained its 580M model at ~4.3k tokens/sec/chip on
+TPU v3-32 (derived in BASELINE.md from ``logs/580.md:34,49`` — 97k steps /
+48B tokens / ~4 days / 32 chips). ``vs_baseline`` is the speedup over that
+per-chip figure.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+BASELINE_TOK_S_CHIP = 4300.0  # reference 580M on TPU v3 (BASELINE.md, derived)
+
+
+def main():
+    from zero_transformer_tpu.config import MeshConfig, OptimizerConfig, model_config
+    from zero_transformer_tpu.models.gpt import Transformer
+    from zero_transformer_tpu.parallel.mesh import make_mesh
+    from zero_transformer_tpu.parallel.zero import (
+        init_train_state,
+        make_plan,
+        make_train_step,
+    )
+    from zero_transformer_tpu.training.optimizer import make_optimizer
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    if on_accel:
+        model_name, batch_size, seq, timed_steps = "580m", 8, 1024, 10
+    else:  # keep the CPU smoke path fast
+        model_name, batch_size, seq, timed_steps = "test", 8, 32, 3
+
+    cfg = model_config(model_name, dropout=0.0, remat=True)
+    n_chips = jax.device_count()
+    mesh = make_mesh(MeshConfig(zero_stage=1))
+    model = Transformer(cfg)
+    tx = make_optimizer(OptimizerConfig(warmup_steps=10, total_steps=1000))
+
+    sample_shape = (batch_size, seq)
+    plan = make_plan(model, tx, mesh, sample_shape, zero_stage=1)
+    state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, sample_shape, plan)
+    step = make_train_step(model, tx, mesh, plan, zero_stage=1)
+
+    batch = jax.random.randint(
+        jax.random.PRNGKey(1), (1, batch_size, seq), 0, cfg.vocab_size, jnp.int32
+    )
+    rng = jax.random.PRNGKey(2)
+
+    # warmup / compile. NOTE: sync via a scalar fetch, not block_until_ready —
+    # on the tunneled TPU platform in this image block_until_ready returns
+    # before execution finishes; fetching an output of the step executable is
+    # the reliable barrier (all steps chain through the donated state).
+    state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = batch_size * seq * timed_steps
+    tok_s_chip = tokens / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": f"train_tokens_per_sec_per_chip_{model_name}",
+                "value": round(tok_s_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(tok_s_chip / BASELINE_TOK_S_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
