@@ -1,0 +1,57 @@
+"""Data pipeline: token sources + sharded batch loading (no torch anywhere)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from zero_transformer_tpu.config import Config
+from zero_transformer_tpu.data.loader import DataLoader, device_put_batch  # noqa: F401
+from zero_transformer_tpu.data.sources import (  # noqa: F401
+    HFSource,
+    MemmapSource,
+    SyntheticSource,
+    TokenSource,
+    write_memmap,
+)
+
+
+def make_source(cfg: Config, validation: bool = False) -> TokenSource:
+    """Build the TokenSource named by ``cfg.data.source``."""
+    data = cfg.data
+    path = data.validation_path if validation else data.train_path
+    if data.source == "synthetic":
+        return SyntheticSource(
+            vocab_size=cfg.model.vocab_size,
+            max_context=data.max_context,
+            seed=data.shuffle_seed + (1 if validation else 0),
+        )
+    if data.source == "memmap":
+        return MemmapSource(
+            path,
+            max_context=data.max_context,
+            shuffle=not validation,
+            seed=data.shuffle_seed,
+        )
+    if data.source == "hf":
+        return HFSource(path, max_context=data.max_context)
+    raise ValueError(f"unknown data source {cfg.data.source!r}")
+
+
+def make_loader(
+    cfg: Config,
+    validation: bool = False,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> DataLoader:
+    source = make_source(cfg, validation)
+    return DataLoader(
+        source,
+        batch_size=cfg.training.batch_size,
+        train_context=cfg.training.train_context,
+        accum_steps=1 if validation else cfg.training.gradient_accumulation_steps,
+        process_index=process_index,
+        process_count=process_count,
+        shuffle_buffer=0 if validation else (
+            cfg.data.shuffle_buffer if cfg.data.source == "hf" else 0
+        ),
+        seed=cfg.data.shuffle_seed,
+    )
