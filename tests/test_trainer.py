@@ -1,0 +1,127 @@
+"""End-to-end trainer tests on an 8-device mesh: loss decreases, eval runs,
+checkpoints land, and interrupted+resumed training exactly matches an
+uninterrupted run — the distributed-testing tier the reference lacks
+entirely (SURVEY §4: "Distributed testing: none automated")."""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from zero_transformer_tpu.config import (
+    CheckpointConfig,
+    Config,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    TrainingConfig,
+)
+from zero_transformer_tpu.training.trainer import Trainer
+
+
+def tiny_config(tmp_path, total_steps=20, zero_stage=1, data=None, **ckpt_kwargs) -> Config:
+    return Config(
+        model=ModelConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                          max_seq_len=16, dropout=0.0),
+        mesh=MeshConfig(zero_stage=zero_stage),
+        optimizer=OptimizerConfig(peak_learning_rate=1e-2, warmup_steps=2,
+                                  total_steps=total_steps),
+        training=TrainingConfig(batch_size=8, train_context=16, total_steps=total_steps,
+                                evaluation_frequency=10, maximum_evaluation_steps=2,
+                                log_frequency=5, seed=0),
+        data=data or DataConfig(source="synthetic", max_context=16),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "run"),
+                                    save_frequency=10, async_save=False,
+                                    **ckpt_kwargs),
+    )
+
+
+def structured_data(tmp_path) -> DataConfig:
+    """A learnable corpus: cyclic 0..63 token stream (next token is a pure
+    function of the current one), so a working train loop must cut loss far
+    below the uniform-random ln(64) floor."""
+    from zero_transformer_tpu.data.sources import write_memmap
+
+    tokens = np.tile(np.arange(64, dtype=np.uint16), 64)
+    path = str(tmp_path / "train.bin")
+    write_memmap(tokens, path)
+    return DataConfig(source="memmap", train_path=path, validation_path=path,
+                      max_context=16)
+
+
+def params_equal(a, b, rtol=1e-6):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=1e-7)
+
+
+def test_loss_decreases_and_artifacts(tmp_path, devices):
+    cfg = tiny_config(tmp_path, total_steps=20, data=structured_data(tmp_path))
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    first_eval = trainer.evaluate(state)["loss"]
+    state = trainer.train()
+    assert int(state.step) == 20
+    final_eval = trainer.evaluate(state)["loss"]
+    assert final_eval < first_eval - 0.5, (first_eval, final_eval)
+
+    # metrics jsonl written with expected keys
+    lines = [json.loads(l) for l in
+             (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()]
+    train_lines = [l for l in lines if "train/loss" in l]
+    assert train_lines and "train/learning_rate" in train_lines[0]
+    assert any("validation/loss" in l for l in lines)
+    # checkpoints at save_frequency
+    assert trainer.ckpt.all_steps() == [10, 20]
+    trainer.close()
+
+
+def test_resume_matches_uninterrupted(tmp_path, devices):
+    # uninterrupted 20 steps
+    cfg_a = tiny_config(tmp_path / "a", total_steps=20)
+    trainer_a = Trainer(cfg_a)
+    state_a = trainer_a.train()
+    trainer_a.close()
+
+    # interrupted at 10, resumed to 20
+    cfg_b = tiny_config(tmp_path / "b", total_steps=20)
+    trainer_b = Trainer(cfg_b)
+    trainer_b.train(max_steps=10)
+    trainer_b.close()
+
+    cfg_b2 = tiny_config(tmp_path / "b", total_steps=20, resume=True)
+    trainer_b2 = Trainer(cfg_b2)
+    state_b = trainer_b2.train()
+    trainer_b2.close()
+
+    assert int(state_b.step) == 20
+    params_equal(state_a.params, state_b.params, rtol=1e-5)
+
+
+@pytest.mark.parametrize("zero_stage", [2, 3])
+def test_trains_at_higher_zero_stages(tmp_path, devices, zero_stage):
+    cfg = tiny_config(tmp_path, total_steps=6, zero_stage=zero_stage)
+    trainer = Trainer(cfg)
+    state = trainer.train()
+    assert int(state.step) == 6
+    loss = trainer.evaluate(state)["loss"]
+    assert np.isfinite(loss)
+    trainer.close()
+
+
+def test_warm_init_copies_params(tmp_path, devices):
+    donor_cfg = tiny_config(tmp_path / "donor", total_steps=5)
+    donor = Trainer(donor_cfg)
+    donor_state = donor.train()
+    donor.close()
+
+    warm_cfg = tiny_config(tmp_path / "warm", total_steps=5,
+                           warm_init=True,
+                           warm_init_dir=str(tmp_path / "donor" / "run"))
+    warm = Trainer(warm_cfg)
+    state = warm.init_state()
+    params_equal(donor_state.params, state.params)
+    assert int(state.step) == 0  # fresh optimizer/step, donor params
+    warm.close()

@@ -1,0 +1,77 @@
+"""Training entry point (reference: ``python main_zero.py``, ``main_zero.py:41-55``).
+
+Usage:
+    python train.py --cfg configs/train_125m.yaml [--resume] [--set key=value ...]
+
+``--set`` overrides any dotted config field, e.g.
+``--set training.total_steps=100 model.n_layers=4``.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+
+import jax
+
+
+def parse_overrides(pairs):
+    out = {}
+    for pair in pairs or []:
+        key, _, raw = pair.partition("=")
+        try:
+            import ast
+
+            out[key] = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            out[key] = raw
+    return out
+
+
+def apply_overrides(cfg, overrides: dict):
+    for dotted, value in overrides.items():
+        section_name, _, field = dotted.partition(".")
+        section = getattr(cfg, section_name)
+        if not field or not hasattr(section, field):
+            raise ValueError(f"unknown config field {dotted!r}")
+        cfg = dataclasses.replace(
+            cfg, **{section_name: dataclasses.replace(section, **{field: value})}
+        )
+    return cfg
+
+
+def main():
+    parser = argparse.ArgumentParser(description="TPU-native ZeRO transformer trainer")
+    parser.add_argument("--cfg", default="configs/train_test.yaml")
+    parser.add_argument("--resume", action="store_true", default=False)
+    parser.add_argument("--wandb", action="store_true", default=False)
+    parser.add_argument("--max-steps", type=int, default=None)
+    parser.add_argument("--set", nargs="*", default=None, metavar="KEY=VALUE")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    from zero_transformer_tpu.config import load_config
+    from zero_transformer_tpu.training.trainer import Trainer
+
+    cfg = load_config(args.cfg)
+    cfg = apply_overrides(cfg, parse_overrides(args.set))
+    if args.resume:
+        cfg = dataclasses.replace(
+            cfg, checkpoint=dataclasses.replace(cfg.checkpoint, resume=True)
+        )
+
+    logging.info(
+        "devices=%d processes=%d backend=%s",
+        jax.device_count(),
+        jax.process_count(),
+        jax.default_backend(),
+    )
+    trainer = Trainer(cfg, use_wandb=args.wandb)
+    try:
+        trainer.train(max_steps=args.max_steps)
+    finally:
+        trainer.close()
+
+
+if __name__ == "__main__":
+    main()
