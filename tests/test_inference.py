@@ -1,0 +1,187 @@
+"""Inference tests: sampling processors + KV-cached generation.
+
+Counterpart of the reference's torch-side tests
+(``torch_compatability/test_torch_models.py:42-160``: forward shapes, KV-cache
+growth) plus the decode-equals-full-forward check its Flax side never had.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import ModelConfig
+from zero_transformer_tpu.inference import (
+    SamplingConfig,
+    apply_repetition_penalty,
+    decode_model,
+    generate,
+    init_cache,
+    prefill,
+    sample_token,
+    top_k_filter,
+    top_p_filter,
+)
+from zero_transformer_tpu.models import Transformer
+
+CFG = ModelConfig(
+    name="t", vocab_size=64, d_model=32, n_heads=4, n_layers=2, max_seq_len=32,
+    dropout=0.0, compute_dtype="float32",
+)
+
+
+# -- logit processors ---------------------------------------------------------
+
+
+def test_top_k_keeps_k():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = top_k_filter(logits, 2)
+    assert (out > -1e9).sum() == 2
+    assert float(out[0, 1]) == 5.0 and float(out[0, 4]) == 4.0
+
+
+def test_top_k_disabled():
+    logits = jnp.asarray([[1.0, 5.0, 3.0]])
+    np.testing.assert_array_equal(top_k_filter(logits, 0), logits)
+    np.testing.assert_array_equal(top_k_filter(logits, 3), logits)
+
+
+def test_top_p_keeps_nucleus():
+    # probs ~ [0.64, 0.24, 0.09, 0.03]; p=0.7 keeps the first two (first token
+    # always kept, second kept because cumulative mass before it is < p)
+    logits = jnp.log(jnp.asarray([[0.64, 0.24, 0.09, 0.03]]))
+    out = top_p_filter(logits, 0.7)
+    kept = out > -1e9
+    np.testing.assert_array_equal(kept, [[True, True, False, False]])
+
+
+def test_top_p_always_keeps_top1():
+    logits = jnp.log(jnp.asarray([[0.97, 0.01, 0.01, 0.01]]))
+    out = top_p_filter(logits, 0.5)
+    assert bool(out[0, 0] > -1e9)
+
+
+def test_repetition_penalty_signs():
+    logits = jnp.asarray([[2.0, -2.0, 1.0]])
+    mask = jnp.asarray([[True, True, False]])
+    out = apply_repetition_penalty(logits, mask, 2.0)
+    np.testing.assert_allclose(out, [[1.0, -4.0, 1.0]])
+
+
+def test_greedy_sampling_is_argmax():
+    logits = jnp.asarray([[0.1, 3.0, 0.2], [5.0, 0.0, 0.1]])
+    tok = sample_token(jax.random.PRNGKey(0), logits, SamplingConfig(greedy=True))
+    np.testing.assert_array_equal(tok, [1, 0])
+
+
+def test_categorical_respects_filter():
+    logits = jnp.asarray([[0.0, 10.0, 0.1, 0.2]])
+    cfg = SamplingConfig(top_k=1)
+    toks = [
+        int(sample_token(jax.random.PRNGKey(i), logits, cfg)[0]) for i in range(8)
+    ]
+    assert set(toks) == {1}
+
+
+# -- KV-cache decode ----------------------------------------------------------
+
+
+def _params(model, B=1, T=8):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((B, T), jnp.int32))["params"]
+
+
+@pytest.mark.parametrize("position", ["alibi", "rope", "learned"])
+def test_cached_decode_matches_full_forward(position):
+    """Prefill + per-token cached decode must reproduce the uncached forward
+    logits at every position (the invariant behind the reference's KV cache,
+    ``GPT2.py:175-245``)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, position=position)
+    full = Transformer(cfg)
+    dec = decode_model(cfg, cache_len=16)
+    B, T = 2, 10
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+    params = _params(full, B, T)
+
+    ref_logits = full.apply({"params": params}, x)  # [B, T, V]
+
+    cache = init_cache(dec, B)
+    last, cache = prefill(dec, params, x[:, :4], cache)
+    np.testing.assert_allclose(last, ref_logits[:, 3], atol=1e-4, rtol=1e-4)
+    for t in range(4, T):
+        logits, vars_out = dec.apply(
+            {"params": params, "cache": cache}, x[:, t : t + 1], mutable=["cache"]
+        )
+        cache = vars_out["cache"]
+        np.testing.assert_allclose(
+            logits[:, 0], ref_logits[:, t], atol=1e-4, rtol=1e-4,
+            err_msg=f"position {t}",
+        )
+
+
+def test_generate_greedy_matches_manual_loop():
+    model = decode_model(CFG, cache_len=24)
+    full = Transformer(CFG)
+    params = _params(full)
+    prompt = jnp.asarray([[5, 9, 11]], jnp.int32)
+    out = generate(
+        model, params, prompt, 6, jax.random.PRNGKey(0),
+        SamplingConfig(greedy=True),
+    )
+    assert out.shape == (1, 6)
+
+    # manual uncached argmax loop
+    seq = prompt
+    expect = []
+    for _ in range(6):
+        logits = full.apply({"params": params}, seq)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        expect.append(nxt)
+        seq = jnp.concatenate([seq, jnp.asarray([[nxt]], jnp.int32)], axis=1)
+    np.testing.assert_array_equal(out[0], expect)
+
+
+def test_generate_eos_stops_and_pads():
+    model = decode_model(CFG, cache_len=40)
+    full = Transformer(CFG)
+    params = _params(full)
+    prompt = jnp.asarray([[5, 9, 11]], jnp.int32)
+    base = generate(
+        model, params, prompt, 8, jax.random.PRNGKey(0), SamplingConfig(greedy=True)
+    )
+    eos = int(base[0, 2])  # pretend this generated token is EOS
+    first = int(np.argmax(np.asarray(base[0]) == eos))  # first occurrence
+    out = generate(
+        model, params, prompt, 8, jax.random.PRNGKey(0),
+        SamplingConfig(greedy=True), eos_token_id=eos, pad_token_id=63,
+    )
+    np.testing.assert_array_equal(out[0, : first + 1], base[0, : first + 1])
+    np.testing.assert_array_equal(out[0, first + 1 :], [63] * (7 - first))
+
+
+def test_generate_batched():
+    model = decode_model(CFG, cache_len=24)
+    full = Transformer(CFG)
+    params = _params(full, B=2)
+    prompt = jnp.asarray([[5, 9, 11], [3, 2, 1]], jnp.int32)
+    out = generate(
+        model, params, prompt, 5, jax.random.PRNGKey(1), SamplingConfig(greedy=True)
+    )
+    # each row equals its own single-row generation
+    for b in range(2):
+        row = generate(
+            model, params, prompt[b : b + 1], 5, jax.random.PRNGKey(1),
+            SamplingConfig(greedy=True),
+        )
+        np.testing.assert_array_equal(out[b], row[0])
+
+
+def test_generate_overflow_rejected():
+    model = decode_model(CFG, cache_len=8)
+    full = Transformer(CFG)
+    params = _params(full)
+    with pytest.raises(ValueError):
+        generate(
+            model, params, jnp.zeros((1, 6), jnp.int32), 6, jax.random.PRNGKey(0)
+        )

@@ -1,0 +1,180 @@
+"""KV-cached autoregressive generation, fully under jit.
+
+In-tree JAX replacement for the reference's CUDA-only inference stack
+(reference ``torch_compatability/GPT2.py:354-445`` ``generate``/KV cache and
+``app.py:42-94`` streaming loop). Design differences, TPU-first:
+
+- ONE compiled program for prefill and one for the whole decode loop
+  (``lax.while_loop`` with a fixed-shape cache and early exit when every
+  sequence hits EOS) — the reference re-enters Python per token;
+- the KV cache is preallocated [B, cache_len] (model's ``decode=True``
+  variant), so shapes are static and XLA never re-tiles — the reference's
+  torch path instead rebuilds its ALiBi mask whenever the context grows
+  (``GPT2.py:191-235``);
+- batch generation is native: [B, T] prompts in, [B, max_new_tokens] out,
+  per-row EOS masking; the reference generates one sequence at a time.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zero_transformer_tpu.config import ModelConfig
+from zero_transformer_tpu.inference.sampling import SamplingConfig, sample_token
+from zero_transformer_tpu.models.gpt import Transformer
+
+
+def decode_model(cfg: ModelConfig, cache_len: int) -> Transformer:
+    """The KV-cache variant of the model (same params as the training one)."""
+    return Transformer(cfg, decode=True, cache_len=cache_len)
+
+
+def init_cache(model: Transformer, batch: int, rng=None) -> Any:
+    """Allocate the zeroed cache collection for a [batch, cache_len] run.
+
+    Shapes come from ``eval_shape`` (no parameter materialization — a fresh
+    full ``model.init`` here would transiently double peak HBM on large
+    models); the cache contents are genuinely zeros + zero indices, which is
+    exactly what a fresh init produces."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((batch, 1), jnp.int32)), rng
+    )["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def prefill(
+    model: Transformer, params: Any, prompt: jax.Array, cache: Any
+) -> Tuple[jax.Array, Any]:
+    """Run the prompt through the model, filling the cache.
+
+    Returns (last-position logits [B, V], cache)."""
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"]
+    )
+    return logits[:, -1, :].astype(jnp.float32), vars_out["cache"]
+
+
+def generate(
+    model: Transformer,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    rng: jax.Array,
+    sampling: SamplingConfig = SamplingConfig(),
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations for a [B, T] prompt.
+
+    Returns [B, max_new_tokens] int32. Rows that hit ``eos_token_id`` are
+    padded with ``pad_token_id`` afterwards; the loop exits early once every
+    row is done (the reference's EOS handling, ``app.py:79-92``, single-row).
+    """
+    cache_len = model.cache_len or model.cfg.max_seq_len
+    B, T = prompt.shape
+    if T + max_new_tokens > cache_len:
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache_len ({cache_len})"
+        )
+    if model.cfg.position == "learned" and T + max_new_tokens > model.cfg.max_seq_len:
+        # the wpe table cannot extrapolate; traced decode positions past it
+        # would silently clamp to the last row (XLA gather semantics)
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({model.cfg.max_seq_len}) and learned positions "
+            "cannot extrapolate (use position='alibi' or 'rope')"
+        )
+    cache = init_cache(model, B)
+    last_logits, cache = prefill(model, params, prompt, cache)
+    vocab = last_logits.shape[-1]
+
+    # presence mask of *generated* tokens for the repetition penalty
+    # (reference penalizes generated tokens only, app.py:75,85-88)
+    gen_mask = jnp.zeros((B, vocab), jnp.bool_)
+
+    return _decode_loop(
+        model,
+        max_new_tokens,
+        sampling,
+        -1 if eos_token_id is None else int(eos_token_id),
+        int(pad_token_id),
+        params,
+        last_logits,
+        cache,
+        gen_mask,
+        rng,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=(7,))
+def _decode_loop(
+    model: Transformer,
+    max_new_tokens: int,
+    sampling: SamplingConfig,
+    eos_token_id: int,
+    pad_token_id: int,
+    params: Any,
+    last_logits: jax.Array,
+    cache: Any,
+    gen_mask: jax.Array,
+    rng: jax.Array,
+):
+    B = last_logits.shape[0]
+    out = jnp.full((B, max_new_tokens), pad_token_id, jnp.int32)
+    done = jnp.zeros((B,), jnp.bool_)
+
+    def cond(carry):
+        step, _, _, _, done, _, _ = carry
+        return (step < max_new_tokens) & ~jnp.all(done)
+
+    def body(carry):
+        step, logits, cache, gen_mask, done, out, rng = carry
+        rng, sub = jax.random.split(rng)
+        token = sample_token(sub, logits, sampling, gen_mask)
+        is_eos = token == eos_token_id
+        emitted = jnp.where(done, pad_token_id, token)
+        out = jax.lax.dynamic_update_slice(out, emitted[:, None], (0, step))
+        newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
+        gen_mask = gen_mask | (newly & ~done[:, None])
+        done = done | is_eos
+        next_logits, vars_out = model.apply(
+            {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
+        )
+        return (
+            step + 1,
+            next_logits[:, -1, :].astype(jnp.float32),
+            vars_out["cache"],
+            gen_mask,
+            done,
+            out,
+            rng,
+        )
+
+    carry = (0, last_logits, cache, gen_mask, done, out, rng)
+    _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, carry)
+    return out
+
+
+def generate_tokens(
+    cfg: ModelConfig,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    rng: Optional[jax.Array] = None,
+    cache_len: Optional[int] = None,
+    **kwargs,
+) -> jax.Array:
+    """Convenience wrapper: build the decode model and generate."""
+    if prompt.ndim == 1:
+        prompt = prompt[None, :]
+    total = prompt.shape[1] + max_new_tokens
+    cache_len = cache_len or max(cfg.max_seq_len, total)
+    model = decode_model(cfg, cache_len)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    return generate(model, params, prompt, max_new_tokens, rng, **kwargs)

@@ -1,0 +1,112 @@
+"""Jittable logit processors and token sampling.
+
+TPU-native replacement for the reference's per-token Python/torch logit
+processing (reference ``app.py:97-142``: repetition penalty, top-k, nucleus
+top-p, greedy = top-1). Everything here is shape-static and traceable so the
+whole decode step — model, processors, sampling — compiles into one XLA
+program; the reference instead re-ran Python string/ops per generated token
+(``app.py:69-94``).
+
+Processor semantics match the reference:
+- repetition penalty divides positive / multiplies negative logits of tokens
+  generated so far (``app.py:102-107``), tracked as a [B, vocab] presence mask
+  instead of a Python list;
+- top-k keeps the k best logits (``app.py:111-115``);
+- top-p keeps the smallest prefix of the sorted distribution whose cumulative
+  probability exceeds p, always retaining the top token (``app.py:119-142``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Static sampling hyperparameters (baked into the compiled decode step)."""
+
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 0.0  # 0 = disabled
+    repetition_penalty: float = 1.0  # 1 = disabled
+    greedy: bool = False
+
+    def __post_init__(self):
+        if self.temperature <= 0:
+            raise ValueError("temperature must be > 0")
+        if self.top_p < 0 or self.top_p >= 1.0 and self.top_p != 0.0:
+            raise ValueError("top_p must be in [0, 1)")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+
+def apply_repetition_penalty(
+    logits: jax.Array, generated_mask: jax.Array, penalty: float
+) -> jax.Array:
+    """Penalize tokens already generated. logits [B, V]; mask [B, V] bool."""
+    if penalty == 1.0:
+        return logits
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(generated_mask, penalized, logits)
+
+
+def top_k_filter(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k largest logits per row; mask the rest to NEG_INF."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest prefix with cumulative prob > p."""
+    if p <= 0.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    # a token is dropped when the cumulative mass *before* it already exceeds
+    # p (reference shifts the removal mask right by one, app.py:133-135)
+    exceeded = cum > p
+    drop_sorted = jnp.concatenate(
+        [jnp.zeros_like(exceeded[..., :1]), exceeded[..., :-1]], axis=-1
+    )
+    # threshold = smallest kept logit
+    threshold = jnp.min(
+        jnp.where(drop_sorted, jnp.inf, sorted_logits), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def process_logits(
+    logits: jax.Array,
+    cfg: SamplingConfig,
+    generated_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Temperature → repetition penalty → top-k → top-p (reference order,
+    ``app.py:97-108`` then ``generate_text`` wiring ``app.py:159-175``)."""
+    logits = logits.astype(jnp.float32) / cfg.temperature
+    if generated_mask is not None:
+        logits = apply_repetition_penalty(
+            logits, generated_mask, cfg.repetition_penalty
+        )
+    logits = top_k_filter(logits, cfg.top_k)
+    logits = top_p_filter(logits, cfg.top_p)
+    return logits
+
+
+def sample_token(
+    rng: jax.Array,
+    logits: jax.Array,
+    cfg: SamplingConfig,
+    generated_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample (or argmax) next tokens. logits [B, V] → [B] int32."""
+    logits = process_logits(logits, cfg, generated_mask)
+    if cfg.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

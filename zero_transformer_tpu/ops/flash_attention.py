@@ -1,12 +1,22 @@
-"""Pallas TPU flash attention (placeholder gate — kernel lands in ops/pallas/).
+"""Flash attention dispatch gate for ``ops.attention.dot_product_attention``.
 
-Until the kernel is wired in, ``supported`` returns False so the dispatcher in
-``ops.attention`` always takes the XLA path. This keeps a single call site for
-the hot op while the Pallas implementation matures.
+``supported`` decides whether the Pallas TPU kernel
+(``zero_transformer_tpu.ops.pallas.flash``) handles the call; anything it
+declines (decode steps with a query offset, padded batches via segment_ids,
+CPU test runs, odd shapes) falls back to the XLA path, keeping one call site
+for the hot op.
 """
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
+
+from zero_transformer_tpu.ops.pallas.flash import (
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    flash_attention as _pallas_flash,
+    pick_block,
+)
 
 
 def supported(q, k, v, *, causal: bool, alibi: bool = False, q_offset=0, segment_ids=None) -> bool:
@@ -16,8 +26,20 @@ def supported(q, k, v, *, causal: bool, alibi: bool = False, q_offset=0, segment
         return False
     if segment_ids is not None:
         return False
-    return False  # kernel not wired in yet
+    if jax.default_backend() != "tpu":
+        return False
+    B, T, H, D = q.shape
+    _, S, KVH, _ = k.shape
+    if H % KVH:
+        return False
+    if pick_block(T, DEFAULT_BLOCK_Q) is None or pick_block(S, DEFAULT_BLOCK_K) is None:
+        return False
+    if D % 64 or D > 256:
+        return False  # lane-dim alignment for the MXU
+    if q.dtype not in (jnp.bfloat16, jnp.float32):
+        return False
+    return True
 
 
 def flash_attention(q, k, v, *, causal: bool = True, alibi: bool = False) -> jax.Array:
-    raise NotImplementedError("pallas flash attention not wired in yet")
+    return _pallas_flash(q, k, v, causal=causal, alibi=alibi)

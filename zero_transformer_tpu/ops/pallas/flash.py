@@ -1,0 +1,361 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+Blockwise online-softmax attention that never materializes the [T, T] score
+matrix the reference allocates in full (reference ``src/models/layers.py:159-173``).
+Supports causal masking, ALiBi bias (reference ``layers.py:17-44``), and
+grouped-query attention; softmax statistics are carried in float32 — the dtype
+discipline the reference adopted after its bf16-softmax quality bug
+(reference ``logs/580.md:94-98``).
+
+Kernels run on a [B, H, T, D] layout (Mosaic requires the blocked time axis in
+the sublane position); the public wrapper transposes from the model's
+[B, T, H, D] at the boundary — XLA fuses these transposes into neighboring
+ops. The grid walks (batch, head, q-block, k-block) with the online-softmax
+state (m, l, acc) carried in VMEM scratch across the innermost k-block
+dimension; causally-skipped blocks are predicated off with ``pl.when``. The
+backward pass is two more kernels over the same tiling: one carrying dq across
+k-blocks, one carrying (dk, dv) across q-blocks, both recomputing
+p = exp(s - lse) from the forward's saved logsumexp.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from zero_transformer_tpu.ops.positions import NEG_INF, alibi_slopes
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_INIT_M = -1e30  # below any finite score; never produced by real inputs
+
+
+def _bias_block(
+    slope, i, j, block_q: int, block_k: int, alibi: bool, causal: bool
+):
+    """f32 additive bias for score block (i, j): ALiBi distance + causal mask.
+
+    Matches ``ops.positions.alibi_bias`` / ``causal_mask_bias`` exactly
+    (distance clamped at 0, mask additive NEG_INF) so the kernel is
+    numerically interchangeable with the XLA path.
+    """
+    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    bias = jnp.zeros((block_q, block_k), jnp.float32)
+    if alibi:
+        dist = jnp.maximum(q_pos - k_pos, 0).astype(jnp.float32)
+        bias = bias - slope * dist
+    if causal:
+        bias = bias + jnp.where(k_pos <= q_pos, 0.0, NEG_INF).astype(jnp.float32)
+    return bias
+
+
+def _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j):
+    """[block_q, block_k] f32 score block shared by all three kernels."""
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return s * scale + _bias_block(
+        slope, i, j, q.shape[0], k.shape[0], alibi, causal
+    )
+
+
+def _fwd_kernel(
+    slope_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, alibi: bool, n_k: int,
+):
+    i, j = pl.program_id(2), pl.program_id(3)
+    slope = slope_ref[pl.program_id(1), 0]
+    block_q, block_k = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _INIT_M)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: block (i, j) contributes iff some k_pos <= some q_pos
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        s = _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j)
+        v = v_ref[0, 0, :, :]
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:, :1] = m_new
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    # i is a traced grid index: compute the last contributing j dynamically.
+    last = (
+        jnp.minimum(((i + 1) * block_q - 1) // block_k, n_k - 1)
+        if causal
+        else n_k - 1
+    )
+
+    @pl.when(j == last)
+    def _write():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = (m_scr[:, :1] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _dq_kernel(
+    slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    dq_scr,
+    *, scale: float, causal: bool, alibi: bool, n_k: int,
+):
+    i, j = pl.program_id(2), pl.program_id(3)
+    slope = slope_ref[pl.program_id(1), 0]
+    block_q, block_k = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        s = _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j)
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        p = jnp.exp(s - lse_ref[0, 0, :, :])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0, :, :])
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    last = (
+        jnp.minimum(((i + 1) * block_q - 1) // block_k, n_k - 1)
+        if causal
+        else n_k - 1
+    )
+
+    @pl.when(j == last)
+    def _write():
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale: float, causal: bool, alibi: bool, n_q: int,
+):
+    # grid: (B, H, n_k, n_q) — j is the k-block, inner index i walks q-blocks
+    j, i = pl.program_id(2), pl.program_id(3)
+    slope = slope_ref[pl.program_id(1), 0]
+    block_q, block_k = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        s = _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j)
+        q = q_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        p = jnp.exp(s - lse_ref[0, 0, :, :])  # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0, :, :])
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == n_q - 1)
+    def _write():
+        dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def pick_block(n: int, prefer: int) -> Optional[int]:
+    """Largest block <= prefer (>=128) dividing n, or None if none exists.
+
+    Shared by the wrapper and the dispatch gate (``ops.flash_attention``) so
+    "supported" and "will actually run" can never disagree."""
+    b = min(prefer, n)
+    while b >= 128:
+        if n % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def _slopes_arg(n_heads: int, alibi: bool) -> jax.Array:
+    if alibi:
+        return alibi_slopes(n_heads).reshape(n_heads, 1)
+    return jnp.zeros((n_heads, 1), jnp.float32)
+
+
+def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
+    # [B, T, H, D] → [B, H, T, D]: Mosaic needs the blocked time axis in the
+    # sublane position
+    q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    B, H, T, D = q.shape
+    _, KVH, S, _ = k.shape
+    G = H // KVH
+    n_q, n_k = T // block_q, S // block_k
+
+    slope_spec = pl.BlockSpec(memory_space=pltpu.SMEM if pltpu else None)
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, alibi=alibi, n_k=n_k
+        ),
+        grid=(B, H, n_q, n_k),
+        in_specs=[slope_spec, q_spec, kv_spec, kv_spec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0 used)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l
+            pltpu.VMEM((block_q, D), jnp.float32),  # acc
+        ],
+        interpret=interpret,
+    )(_slopes_arg(H, alibi), q, k, v)
+    return jnp.swapaxes(o, 1, 2), lse
+
+
+def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret):
+    q, k, v, o, do = (jnp.swapaxes(x, 1, 2) for x in (q, k, v, o, do))
+    B, H, T, D = q.shape
+    _, KVH, S, _ = k.shape
+    G = H // KVH
+    n_q, n_k = T // block_q, S // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None]  # [B,H,T,1]
+
+    slope_spec = pl.BlockSpec(memory_space=pltpu.SMEM if pltpu else None)
+    q_spec_iq = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec_iq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
+    row_spec_iq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, alibi=alibi, n_k=n_k
+        ),
+        grid=(B, H, n_q, n_k),
+        in_specs=[slope_spec, q_spec_iq, kv_spec_iq, kv_spec_iq, q_spec_iq, row_spec_iq, row_spec_iq],
+        out_specs=q_spec_iq,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(_slopes_arg(H, alibi), q, k, v, do, lse, delta)
+
+    # k-block-major grid; q walked innermost. dk/dv computed per *query* head
+    # ([B, H, S, D]) then group-summed to KVH for GQA.
+    q_spec_jq = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec_jq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h // G, j, 0))
+    kv_out_jq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+    row_spec_jq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, alibi=alibi, n_q=n_q
+        ),
+        grid=(B, H, n_k, n_q),
+        in_specs=[slope_spec, q_spec_jq, kv_spec_jq, kv_spec_jq, q_spec_jq, row_spec_jq, row_spec_jq],
+        out_specs=[kv_out_jq, kv_out_jq],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_slopes_arg(H, alibi), q, k, v, do, lse, delta)
+
+    dq = jnp.swapaxes(dq, 1, 2)
+    dk = jnp.swapaxes(dk, 1, 2)  # [B, S, H, D]
+    dv = jnp.swapaxes(dv, 1, 2)
+    if G > 1:
+        dk = dk.reshape(B, S, KVH, G, D).sum(axis=3).astype(k.dtype)
+        dv = dv.reshape(B, S, KVH, G, D).sum(axis=3).astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, alibi, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    alibi: bool = False,
+    softmax_scale: Optional[float] = None,
+    block: Optional[int] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Differentiable flash attention. q [B,T,H,D]; k,v [B,S,KVH,D]."""
+    B, T, H, D = q.shape
+    _, S, KVH, _ = k.shape
+    if H % KVH:
+        raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
+    block_q = block_q or block or pick_block(T, DEFAULT_BLOCK_Q) or DEFAULT_BLOCK_Q
+    block_k = block_k or block or pick_block(S, DEFAULT_BLOCK_K) or DEFAULT_BLOCK_K
+    block_q, block_k = min(block_q, T), min(block_k, S)
+    if T % block_q or S % block_k:
+        raise ValueError(
+            f"seq lengths ({T}, {S}) not divisible by blocks ({block_q}, {block_k})"
+        )
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
+    return _flash(q, k, v, causal, alibi, float(scale), block_q, block_k, interpret)
