@@ -1,0 +1,116 @@
+"""Eval-harness tests: loglikelihood scoring, LAMBADA acc/ppl, perplexity/BPB.
+
+The reference had no in-repo eval at all (it exported to PyTorch and ran
+lm-eval-harness on GPU, SURVEY §2); these tests pin the in-tree scoring math
+against hand-computed log-softmax values on a tiny model.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import ModelConfig
+from zero_transformer_tpu.evalharness import lambada, loglikelihoods, perplexity, score_batch
+from zero_transformer_tpu.models import Transformer
+
+CFG = ModelConfig(
+    name="t", vocab_size=64, d_model=32, n_heads=4, n_layers=2, max_seq_len=32,
+    dropout=0.0, compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = Transformer(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _manual_logprob(model, params, tokens, positions):
+    """Sum log P(tokens[t] | tokens[:t]) for t in positions, via full forward."""
+    logits = model.apply({"params": params}, jnp.asarray([tokens], jnp.int32))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)[0]
+    total = 0.0
+    all_greedy = True
+    for t in positions:
+        total += float(logp[t - 1, tokens[t]])
+        all_greedy &= int(jnp.argmax(logp[t - 1])) == tokens[t]
+    return total, all_greedy
+
+
+def test_score_batch_matches_manual(model_and_params):
+    model, params = model_and_params
+    tokens = [5, 9, 11, 3, 7, 2]
+    # continuation = positions 3..5
+    batch = jnp.asarray([tokens], jnp.int32)
+    mask = jnp.asarray([[0, 0, 0, 1, 1, 1]], jnp.int32)
+    res = score_batch(model, params, batch, mask)
+    want, greedy = _manual_logprob(model, params, tokens, [3, 4, 5])
+    np.testing.assert_allclose(float(res["logprob"][0]), want, rtol=1e-5)
+    assert int(res["tokens"][0]) == 3
+    assert bool(res["greedy_match"][0]) == greedy
+
+
+def test_loglikelihoods_padding_invariance(model_and_params):
+    """Scores must not depend on batch padding or row position."""
+    model, params = model_and_params
+    ex = [([5, 9], [11, 3]), ([1, 2, 3], [4]), ([7], [8, 9, 10])]
+    solo = [
+        loglikelihoods(model, params, [e], seq_len=16, batch_size=4)[0] for e in ex
+    ]
+    together = loglikelihoods(model, params, ex, seq_len=16, batch_size=2)
+    for s, t in zip(solo, together):
+        assert s["tokens"] == t["tokens"]
+        np.testing.assert_allclose(s["logprob"], t["logprob"], rtol=1e-4)
+        assert s["greedy_match"] == t["greedy_match"]
+
+
+def test_loglikelihoods_left_truncates_context(model_and_params):
+    model, params = model_and_params
+    long_ctx = list(range(1, 30))
+    res = loglikelihoods(
+        model, params, [(long_ctx, [5, 6])], seq_len=8, batch_size=1
+    )[0]
+    # must equal scoring with only the last 6 context tokens
+    want = loglikelihoods(
+        model, params, [(long_ctx[-6:], [5, 6])], seq_len=8, batch_size=1
+    )[0]
+    np.testing.assert_allclose(res["logprob"], want["logprob"], rtol=1e-5)
+
+
+def test_lambada_metrics(model_and_params):
+    model, params = model_and_params
+    rng = np.random.default_rng(3)
+    examples = [
+        (list(rng.integers(1, 60, 6)), list(rng.integers(1, 60, 2))) for _ in range(5)
+    ]
+    out = lambada(model, params, examples, seq_len=16, batch_size=2)
+    assert out["examples"] == 5
+    assert out["ppl"] > 0 and 0.0 <= out["acc"] <= 1.0
+    # ppl consistent with mean logprob
+    res = loglikelihoods(model, params, examples, seq_len=16, batch_size=2)
+    lp = sum(r["logprob"] for r in res) / sum(r["tokens"] for r in res)
+    np.testing.assert_allclose(out["ppl"], math.exp(-lp), rtol=1e-6)
+
+
+def test_perplexity_and_bpb(model_and_params):
+    model, params = model_and_params
+    rng = np.random.default_rng(4)
+    stream = list(rng.integers(1, 60, 70))
+    out = perplexity(model, params, stream, seq_len=16, batch_size=2, num_bytes=300)
+    assert out["tokens"] == 4 * 15  # 4 windows, seq_len-1 targets each
+    np.testing.assert_allclose(out["ppl"], math.exp(out["nll"] / out["tokens"]), rtol=1e-6)
+    np.testing.assert_allclose(
+        out["bits_per_byte"], out["nll"] / (math.log(2) * 300), rtol=1e-6
+    )
+
+
+def test_perplexity_batch_size_invariance(model_and_params):
+    model, params = model_and_params
+    rng = np.random.default_rng(5)
+    stream = list(rng.integers(1, 60, 80))
+    a = perplexity(model, params, stream, seq_len=16, batch_size=2)
+    b = perplexity(model, params, stream, seq_len=16, batch_size=5)
+    np.testing.assert_allclose(a["nll"], b["nll"], rtol=1e-5)

@@ -1,0 +1,118 @@
+"""Model surgery + export CLI + pod check tests.
+
+Counterpart of the reference's (untested) ``src/utils/extend_params.py`` and
+``torch_compatability/extract_msgpack.py`` paths, plus the pod health check
+(reference ``src/utils/pod_test.py``, manual-only there).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import ModelConfig
+from zero_transformer_tpu.models import Transformer
+from zero_transformer_tpu.utils import surgery
+
+CFG = ModelConfig(
+    name="t", vocab_size=64, d_model=32, n_heads=4, n_layers=2, max_seq_len=16,
+    dropout=0.0, compute_dtype="float32", scan_layers=False,
+)
+
+
+def _params(cfg, seed=0):
+    from zero_transformer_tpu.parallel.sharding import unbox
+
+    model = Transformer(cfg)
+    boxed = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, unbox(boxed)  # surgery operates on TrainState params (unboxed)
+
+
+def test_stack_unstack_round_trip():
+    _, params = _params(CFG)
+    stacked = surgery.stack_blocks(params)
+    assert surgery.is_stacked(stacked)
+    assert surgery.num_layers(stacked) == 2
+    back = surgery.unstack_blocks(stacked)
+    jax.tree.map(np.testing.assert_array_equal, back, params)
+
+
+def test_stacked_equals_scan_layout():
+    """Stacking per-block params must produce the exact tree a scan_layers
+    model initializes — the layout-conversion contract."""
+    scan_cfg = dataclasses.replace(CFG, scan_layers=True)
+    _, scan_params = _params(scan_cfg)
+    _, loop_params = _params(CFG)
+    stacked = surgery.stack_blocks(loop_params)
+    assert jax.tree.structure(stacked) == jax.tree.structure(scan_params)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(stacked)[0],
+        jax.tree_util.tree_flatten_with_path(scan_params)[0],
+    ):
+        assert a.shape == b.shape, (pa, a.shape, b.shape)
+
+
+def test_extend_depth_per_block():
+    _, params = _params(CFG)
+    ext = surgery.extend_depth(params, 4)
+    assert surgery.num_layers(ext) == 4
+    # block i -> blocks 2i, 2i+1 (reference mapping, extend_params.py:46-49)
+    for i in range(2):
+        for j in range(2):
+            jax.tree.map(
+                np.testing.assert_array_equal,
+                ext[f"block_{2 * i + j}"],
+                params[f"block_{i}"],
+            )
+    # non-block params untouched
+    jax.tree.map(np.testing.assert_array_equal, ext["wte"], params["wte"])
+
+    # extended params run in the deeper model
+    big_cfg = dataclasses.replace(CFG, n_layers=4)
+    big = Transformer(big_cfg)
+    out = big.apply({"params": ext}, jnp.zeros((1, 8), jnp.int32))
+    assert out.shape == (1, 8, CFG.vocab_size)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_extend_depth_stacked():
+    _, params = _params(CFG)
+    stacked = surgery.stack_blocks(params)
+    ext = surgery.extend_depth(stacked, 6)
+    assert surgery.is_stacked(ext) and surgery.num_layers(ext) == 6
+    # repeat semantics: rows [0,1,2] from donor row 0, rows [3,4,5] from row 1
+    leaf = jax.tree.leaves(ext["blocks"])[0]
+    donor_leaf = jax.tree.leaves(stacked["blocks"])[0]
+    for i in range(2):
+        for j in range(3):
+            np.testing.assert_array_equal(leaf[3 * i + j], donor_leaf[i])
+
+
+def test_extend_depth_rejects_non_multiple():
+    _, params = _params(CFG)
+    with pytest.raises(ValueError):
+        surgery.extend_depth(params, 3)
+
+
+def test_export_cli_round_trip(tmp_path):
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.checkpoint import import_params_msgpack
+    from zero_transformer_tpu.export import main as export_main
+
+    _, params = _params(CFG)
+    src = tmp_path / "donor.msgpack"
+    src.write_bytes(msgpack_serialize(jax.tree.map(np.asarray, params)))
+
+    out = tmp_path / "extended.msgpack"
+    export_main(["extend", "--params", str(src), "--layers", "4", "--out", str(out)])
+    ext = import_params_msgpack(out)
+    assert surgery.num_layers(ext) == 4
+    jax.tree.map(np.testing.assert_array_equal, ext["block_3"], params["block_1"])
+
+
+def test_pod_check_healthy(devices):
+    from zero_transformer_tpu.utils.pod_check import pod_check
+
+    assert pod_check(timeout=120.0, verbose=False)

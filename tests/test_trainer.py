@@ -125,3 +125,37 @@ def test_warm_init_copies_params(tmp_path, devices):
     params_equal(donor_state.params, state.params)
     assert int(state.step) == 0  # fresh optimizer/step, donor params
     warm.close()
+
+
+def test_warm_init_msgpack_with_depth_extension(tmp_path, devices):
+    """Warm start from an exported msgpack of a SHALLOWER donor: depth is
+    auto-extended (Gopher G.3.3, reference extend_params.py) and layouts
+    converted — the reference's 580M->760M scale-up flow, in one config knob."""
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.utils import surgery
+
+    donor_cfg = tiny_config(tmp_path)
+    donor = Trainer(donor_cfg)
+    donor_state = donor.init_state()
+    donor_params = jax.tree.map(np.asarray, donor_state.params)
+    src = tmp_path / "donor.msgpack"
+    src.write_bytes(msgpack_serialize(donor_params))
+    donor.close()
+
+    big = tiny_config(
+        tmp_path / "big", warm_init=True, warm_init_msgpack=str(src)
+    )
+    big = dataclasses.replace(
+        big, model=dataclasses.replace(big.model, n_layers=4, scan_layers=False)
+    )
+    trainer = Trainer(big)
+    state = trainer.init_state()
+    got = jax.tree.map(np.asarray, state.params)
+    assert surgery.num_layers(got) == 4 and not surgery.is_stacked(got)
+    # block 1 of the donor stack lands in blocks 2 and 3
+    donor_blocks = surgery.unstack_blocks(donor_params)
+    params_equal(got["block_2"], donor_blocks["block_1"])
+    params_equal(got["block_3"], donor_blocks["block_1"])
+    params_equal(got["wte"], donor_params["wte"])
+    trainer.close()

@@ -174,6 +174,9 @@ class CheckpointConfig:
     resume: bool = False
     warm_init: bool = False
     warm_init_dir: str = ""
+    # warm start from an exported params msgpack instead of a checkpoint dir;
+    # depth is auto-extended (Gopher G.3.3) and the layer layout auto-converted
+    warm_init_msgpack: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,6 @@
+"""In-tree TPU eval harness (replaces the reference's export-to-PyTorch +
+GPU lm-eval-harness loop, reference ``torch_compatability/`` + ``README.md:53-57``)."""
+from zero_transformer_tpu.evalharness.scoring import loglikelihoods, score_batch
+from zero_transformer_tpu.evalharness.tasks import lambada, perplexity
+
+__all__ = ["lambada", "loglikelihoods", "perplexity", "score_batch"]

@@ -1,0 +1,111 @@
+"""Jitted log-likelihood scoring primitives.
+
+The reference exports checkpoints to PyTorch and runs lm-eval-harness on a
+CUDA GPU to get its LAMBADA / PIQA / Pile numbers (reference ``README.md:53-57``,
+``torch_compatability/GPT2.py:358`` keeps a cache-less ``generate`` purely for
+harness compatibility). Here the same measurements run in-tree on TPU:
+fixed-shape batched scoring under one jit, no export step, no torch.
+
+Conventions (lm-eval-harness "loglikelihood" semantics):
+- an example is (context tokens, continuation tokens);
+- score = sum of log P(continuation_t | context, continuation_<t);
+- "greedy match" = every continuation token is the argmax — the accuracy
+  criterion for LAMBADA.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zero_transformer_tpu.models.gpt import Transformer
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def score_batch(
+    model: Transformer,
+    params: Any,
+    tokens: jax.Array,
+    target_mask: jax.Array,
+) -> dict:
+    """Score target positions of a [B, T] batch.
+
+    ``target_mask`` [B, T] marks positions whose tokens are *predicted*
+    (i.e. the continuation); position t is predicted from logits at t-1.
+    Returns per-example sum logprob, token count, and whether every target
+    token was the argmax. Softmax runs in float32 (the dtype discipline of
+    reference ``src/utils/losses.py:22``).
+    """
+    logits = model.apply({"params": params}, tokens)  # [B, T, V]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # logits at t predict token at t+1
+    pred_logp = logp[:, :-1, :]
+    targets = tokens[:, 1:]
+    mask = target_mask[:, 1:].astype(jnp.float32)
+    tok_logp = jnp.take_along_axis(pred_logp, targets[..., None], axis=-1)[..., 0]
+    greedy = (jnp.argmax(pred_logp, axis=-1) == targets).astype(jnp.float32)
+    return {
+        "logprob": jnp.sum(tok_logp * mask, axis=-1),
+        "tokens": jnp.sum(mask, axis=-1),
+        "greedy_match": jnp.all(jnp.where(mask > 0, greedy, 1.0) > 0, axis=-1),
+    }
+
+
+def _pad_batch(
+    examples: Sequence[Tuple[Sequence[int], Sequence[int]]],
+    seq_len: int,
+    batch: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Right-pad (context, continuation) pairs to [batch, seq_len].
+
+    Left-truncates long contexts (keeps the continuation intact) — the
+    sliding-window convention lm-eval-harness uses for fixed-ctx models.
+    """
+    tokens = np.zeros((batch, seq_len), np.int32)
+    mask = np.zeros((batch, seq_len), np.int32)
+    valid = np.zeros((batch,), np.int32)
+    for i, (ctx, cont) in enumerate(examples):
+        ctx, cont = list(ctx), list(cont)
+        if not cont:
+            raise ValueError("empty continuation")
+        if len(cont) >= seq_len:
+            raise ValueError(f"continuation ({len(cont)}) must be < seq_len ({seq_len})")
+        keep_ctx = min(len(ctx), seq_len - len(cont))
+        if keep_ctx < 1:
+            raise ValueError("need at least one context token")
+        row = ctx[len(ctx) - keep_ctx :] + cont
+        tokens[i, : len(row)] = row
+        mask[i, keep_ctx : len(row)] = 1
+        valid[i] = 1
+    return tokens, mask, valid
+
+
+def loglikelihoods(
+    model: Transformer,
+    params: Any,
+    examples: Iterable[Tuple[Sequence[int], Sequence[int]]],
+    seq_len: int,
+    batch_size: int = 8,
+) -> List[dict]:
+    """Score every (context, continuation) pair; returns one dict per example
+    with ``logprob``, ``tokens``, ``greedy_match``."""
+    examples = list(examples)
+    out: List[dict] = []
+    for start in range(0, len(examples), batch_size):
+        chunk = examples[start : start + batch_size]
+        pad_n = batch_size - len(chunk)
+        padded = chunk + [([0], [0])] * pad_n  # dummy rows, dropped below
+        tokens, mask, _ = _pad_batch(padded, seq_len, batch_size)
+        res = score_batch(model, params, jnp.asarray(tokens), jnp.asarray(mask))
+        for i in range(len(chunk)):
+            out.append(
+                {
+                    "logprob": float(res["logprob"][i]),
+                    "tokens": int(res["tokens"][i]),
+                    "greedy_match": bool(res["greedy_match"][i]),
+                }
+            )
+    return out

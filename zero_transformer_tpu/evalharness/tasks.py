@@ -1,0 +1,89 @@
+"""Evaluation tasks: LAMBADA-style last-word prediction, document perplexity,
+bits-per-byte.
+
+Computes on TPU, in-tree, the metrics the reference could only get by
+exporting to PyTorch + lm-eval-harness on a GPU (reference ``README.md:53-57``
+LAMBADA PPL/ACC table; ``logs/1B.md:25-29`` Pile bits-per-byte). Inputs are
+token sequences — tokenization happens upstream (``serve.py`` /
+``data.sources``) so the harness has no tokenizer or network dependency.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from zero_transformer_tpu.evalharness.scoring import loglikelihoods, score_batch
+from zero_transformer_tpu.models.gpt import Transformer
+
+
+def lambada(
+    model: Transformer,
+    params: Any,
+    examples: Iterable[Tuple[Sequence[int], Sequence[int]]],
+    seq_len: int,
+    batch_size: int = 8,
+) -> dict:
+    """LAMBADA-style eval: (context, last-word tokens) pairs.
+
+    Returns ``{"ppl", "acc", "examples"}`` — perplexity over the target word
+    tokens and greedy-prediction accuracy, the two numbers the reference
+    reports per model (reference ``README.md:53-57``).
+    """
+    results = loglikelihoods(model, params, examples, seq_len, batch_size)
+    if not results:
+        return {"ppl": float("nan"), "acc": float("nan"), "examples": 0}
+    total_lp = sum(r["logprob"] for r in results)
+    total_tok = sum(r["tokens"] for r in results)
+    acc = sum(r["greedy_match"] for r in results) / len(results)
+    return {
+        "ppl": math.exp(-total_lp / max(total_tok, 1)),
+        "acc": acc,
+        "examples": len(results),
+    }
+
+
+def perplexity(
+    model: Transformer,
+    params: Any,
+    tokens: Sequence[int],
+    seq_len: int,
+    batch_size: int = 8,
+    num_bytes: Optional[int] = None,
+) -> dict:
+    """Token-stream perplexity in non-overlapping [seq_len] windows.
+
+    With ``num_bytes`` (the UTF-8 length of the source text) also reports
+    bits-per-byte: nll_total / (ln2 * bytes) — the Pile metric the reference
+    reports (reference ``logs/1B.md:25-29``, ``logs/760.md:66-70``).
+    """
+    tokens = np.asarray(tokens, np.int32)
+    n_windows = len(tokens) // seq_len
+    if n_windows == 0:
+        raise ValueError(f"need at least {seq_len} tokens, got {len(tokens)}")
+    windows = tokens[: n_windows * seq_len].reshape(n_windows, seq_len)
+
+    total_nll, total_tok = 0.0, 0
+    for start in range(0, n_windows, batch_size):
+        chunk = windows[start : start + batch_size]
+        pad_n = batch_size - len(chunk)
+        if pad_n:
+            chunk = np.concatenate([chunk, np.zeros((pad_n, seq_len), np.int32)])
+        mask = np.ones_like(chunk)
+        mask[len(windows[start : start + batch_size]) :] = 0
+        # every position after the first is a prediction target
+        res = score_batch(model, params, jnp.asarray(chunk), jnp.asarray(mask))
+        n_real = len(windows[start : start + batch_size])
+        total_nll += -float(jnp.sum(res["logprob"][:n_real]))
+        total_tok += int(jnp.sum(res["tokens"][:n_real]))
+
+    out = {
+        "nll": total_nll,
+        "tokens": total_tok,
+        "ppl": math.exp(total_nll / max(total_tok, 1)),
+    }
+    if num_bytes:
+        out["bits_per_byte"] = total_nll / (math.log(2) * num_bytes)
+    return out

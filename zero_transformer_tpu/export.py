@@ -1,0 +1,99 @@
+"""Checkpoint export / import / surgery CLI.
+
+Torch-free replacement for the reference's two-step export pipeline
+(reference ``torch_compatability/extract_msgpack.py:10-17`` pulls params out
+of a TrainState checkpoint into msgpack; ``convert_to_torch.py:13-23`` turns
+that into a CUDA-side state dict). Here the interchange format stays flax
+msgpack — consumable by anything flax — and depth-extension surgery
+(reference ``src/utils/extend_params.py``) is a subcommand instead of a
+notebook ritual.
+
+Usage:
+  python -m zero_transformer_tpu.export extract  --checkpoint-dir ckpts [--step N] --out params.msgpack
+  python -m zero_transformer_tpu.export extend   --params params.msgpack --layers 24 --out big.msgpack
+  python -m zero_transformer_tpu.export inspect  --params params.msgpack
+"""
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import jax
+import numpy as np
+
+
+def _cmd_extract(args) -> None:
+    import orbax.checkpoint as ocp
+
+    from zero_transformer_tpu.checkpoint import export_params_msgpack
+
+    directory = Path(args.checkpoint_dir).absolute()
+    with ocp.CheckpointManager(directory) as mgr:
+        step = args.step if args.step is not None else mgr.latest_step()
+        if step is None:
+            raise SystemExit(f"no checkpoints under {directory}")
+        # structure-agnostic raw read; keep only params
+        restored = mgr.restore(step, args=ocp.args.Composite(state=ocp.args.StandardRestore()))
+    state = restored["state"]
+    params = state["params"] if isinstance(state, dict) else state.params
+    out = export_params_msgpack(params, args.out)
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    print(f"wrote {n:,} params (step {step}) -> {out}")
+
+
+def _cmd_extend(args) -> None:
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.checkpoint import import_params_msgpack
+    from zero_transformer_tpu.utils.surgery import extend_depth, num_layers
+
+    params = import_params_msgpack(args.params)
+    old = num_layers(params)
+    params = extend_depth(params, args.layers)
+    Path(args.out).write_bytes(msgpack_serialize(params))
+    print(f"extended {old} -> {args.layers} layers -> {args.out}")
+
+
+def _cmd_inspect(args) -> None:
+    from zero_transformer_tpu.checkpoint import import_params_msgpack
+    from zero_transformer_tpu.utils.surgery import is_stacked, num_layers
+
+    params = import_params_msgpack(args.params)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    total = 0
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        print(f"{name:60s} {str(leaf.dtype):10s} {tuple(leaf.shape)}")
+        total += int(np.prod(leaf.shape))
+    print(
+        f"-- {total:,} params, {num_layers(params)} layers "
+        f"({'stacked' if is_stacked(params) else 'per-block'} layout)"
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="zero_transformer_tpu.export", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ex = sub.add_parser("extract", help="orbax checkpoint -> params msgpack")
+    ex.add_argument("--checkpoint-dir", required=True)
+    ex.add_argument("--step", type=int, default=None)
+    ex.add_argument("--out", required=True)
+    ex.set_defaults(fn=_cmd_extract)
+
+    et = sub.add_parser("extend", help="depth-extend params (Gopher G.3.3 warm start)")
+    et.add_argument("--params", required=True)
+    et.add_argument("--layers", type=int, required=True)
+    et.add_argument("--out", required=True)
+    et.set_defaults(fn=_cmd_extend)
+
+    ins = sub.add_parser("inspect", help="list tensors in a params msgpack")
+    ins.add_argument("--params", required=True)
+    ins.set_defaults(fn=_cmd_inspect)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

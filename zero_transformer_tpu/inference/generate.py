@@ -77,7 +77,8 @@ def generate(
     """
     cache_len = model.cache_len or model.cfg.max_seq_len
     B, T = prompt.shape
-    if T + max_new_tokens > cache_len:
+    # the final sampled token is never fed back, so cache holds T+max_new-1
+    if T + max_new_tokens - 1 > cache_len:
         raise ValueError(
             f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"cache_len ({cache_len})"
@@ -112,7 +113,7 @@ def generate(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=(7,))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _decode_loop(
     model: Transformer,
     max_new_tokens: int,
@@ -143,18 +144,21 @@ def _decode_loop(
         newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
         gen_mask = gen_mask | (newly & ~done[:, None])
         done = done | is_eos
-        next_logits, vars_out = model.apply(
-            {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
+
+        def forward(cache):
+            next_logits, vars_out = model.apply(
+                {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
+            )
+            return next_logits[:, -1, :].astype(jnp.float32), vars_out["cache"]
+
+        # the last emitted token is never fed back — skip its forward
+        logits, cache = jax.lax.cond(
+            (step + 1 < max_new_tokens) & ~jnp.all(done),
+            forward,
+            lambda cache: (logits, cache),
+            cache,
         )
-        return (
-            step + 1,
-            next_logits[:, -1, :].astype(jnp.float32),
-            vars_out["cache"],
-            gen_mask,
-            done,
-            out,
-            rng,
-        )
+        return (step + 1, logits, cache, gen_mask, done, out, rng)
 
     carry = (0, last_logits, cache, gen_mask, done, out, rng)
     _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, carry)

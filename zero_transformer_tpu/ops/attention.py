@@ -88,12 +88,8 @@ def dot_product_attention(
         if fa.supported(
             q, k, v, causal=causal, alibi=alibi, q_offset=q_offset, segment_ids=segment_ids
         ):
-            try:
-                return fa.flash_attention(q, k, v, causal=causal, alibi=alibi)
-            except NotImplementedError:
-                if impl == "flash":
-                    raise
-        elif impl == "flash":
+            return fa.flash_attention(q, k, v, causal=causal, alibi=alibi)
+        if impl == "flash":
             raise NotImplementedError(
                 f"flash attention unsupported for shapes q={q.shape} k={k.shape}"
             )

@@ -1,0 +1,197 @@
+"""Text-generation CLI / demo server on TPU.
+
+Replaces the reference's CUDA-only Gradio app (reference ``app.py``: hard
+``torch.cuda.is_available()`` gate at :23-24, per-token Python sampling loop
+at :69-94) with the in-tree jitted decode path. Runs as:
+
+  python -m zero_transformer_tpu.serve --model 1_3b --params params.msgpack \\
+      [--tokenizer <hf name or local path>] [--prompt "..."] [--ui]
+
+- with ``--prompt``: one-shot generation to stdout;
+- without: an interactive REPL;
+- with ``--ui``: the same controls in a Gradio web UI when gradio is
+  importable (it is not baked into this image — the CLI is the primary
+  surface; the reference made the UI the only surface).
+
+The sampling controls mirror the reference UI (``app.py:199-259``):
+temperature, top-k, top-p, repetition penalty, max tokens, greedy toggle.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _load_tokenizer(name_or_path: str):
+    """GPT-NeoX tokenizer by default (what the reference trained with,
+    reference ``app.py:27``). Must resolve locally — this environment has no
+    egress, so pass a local path when the HF cache is cold."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(name_or_path)
+
+
+class TextGenerator:
+    """Tokenizer + params + compiled decode loop behind one ``__call__``."""
+
+    def __init__(self, cfg, params: Any, tokenizer, cache_len: Optional[int] = None):
+        from zero_transformer_tpu.inference import decode_model
+
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.cache_len = cache_len or cfg.max_seq_len
+        self.model = decode_model(cfg, self.cache_len)
+        self.params = params
+
+    def __call__(
+        self,
+        prompt: str,
+        max_new_tokens: int = 128,
+        temperature: float = 0.8,
+        top_k: int = 0,
+        top_p: float = 0.9,
+        repetition_penalty: float = 1.1,
+        greedy: bool = False,
+        seed: int = 0,
+    ) -> str:
+        from zero_transformer_tpu.inference import SamplingConfig, generate
+
+        ids = self.tokenizer.encode(prompt.strip())
+        budget = self.cache_len - max_new_tokens
+        if budget < 1:
+            raise ValueError("max_new_tokens leaves no room for the prompt")
+        ids = ids[-budget:]  # keep the tail (reference app.py:61-64)
+        sampling = SamplingConfig(
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            repetition_penalty=repetition_penalty,
+            greedy=greedy,
+        )
+        eos = self.tokenizer.eos_token_id
+        out = generate(
+            self.model,
+            self.params,
+            jnp.asarray([ids], jnp.int32),
+            max_new_tokens,
+            jax.random.PRNGKey(seed),
+            sampling,
+            eos_token_id=eos,
+            # pad finished rows with EOS so stripping EOS below also strips
+            # padding, whatever the tokenizer's ids are
+            pad_token_id=eos if eos is not None else 0,
+        )
+        toks = [t for t in out[0].tolist() if t != eos]
+        return self.tokenizer.decode(toks)
+
+
+def _build_generator(args) -> TextGenerator:
+    from zero_transformer_tpu.checkpoint import import_params_msgpack
+    from zero_transformer_tpu.config import model_config
+
+    cfg = model_config(args.model, compute_dtype=args.dtype, dropout=0.0)
+    params = import_params_msgpack(args.params)
+    params = jax.tree.map(jnp.asarray, params)
+    tokenizer = _load_tokenizer(args.tokenizer)
+    return TextGenerator(cfg, params, tokenizer, cache_len=args.cache_len)
+
+
+def _repl(gen: TextGenerator, args) -> None:
+    print("zero_transformer_tpu generation REPL — empty line to exit")
+    while True:
+        try:
+            prompt = input(">>> ")
+        except EOFError:
+            return
+        if not prompt.strip():
+            return
+        print(
+            gen(
+                prompt,
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
+                repetition_penalty=args.repetition_penalty,
+                greedy=args.greedy,
+            )
+        )
+
+
+def _ui(gen: TextGenerator) -> None:
+    try:
+        import gradio as gr
+    except ImportError:
+        raise SystemExit(
+            "gradio is not installed in this environment; use the CLI/REPL "
+            "surface instead (the reference's UI dependency made serving "
+            "CUDA+gradio-only, app.py:192-261)"
+        )
+    # mirror of the reference's controls (app.py:199-259)
+    demo = gr.Interface(
+        fn=lambda prompt, steps, temp, tk, tp, rp, greedy: gen(
+            prompt,
+            max_new_tokens=int(steps),
+            temperature=temp,
+            top_k=int(tk),
+            top_p=tp,
+            repetition_penalty=rp,
+            greedy=greedy,
+        ),
+        inputs=[
+            gr.Textbox(label="Prompt"),
+            gr.Slider(1, 512, value=128, label="Max new tokens"),
+            gr.Slider(0.1, 2.0, value=0.8, label="Temperature"),
+            gr.Slider(0, 100, value=0, label="Top-k (0 = off)"),
+            gr.Slider(0.0, 0.99, value=0.9, label="Top-p (0 = off)"),
+            gr.Slider(1.0, 2.0, value=1.1, label="Repetition penalty"),
+            gr.Checkbox(label="Greedy"),
+        ],
+        outputs=gr.Textbox(label="Completion"),
+    )
+    demo.launch()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="zero_transformer_tpu.serve", description=__doc__)
+    p.add_argument("--model", required=True, help="model zoo name (configs/models.yaml)")
+    p.add_argument("--params", required=True, help="params msgpack (see export)")
+    p.add_argument("--tokenizer", default="EleutherAI/gpt-neox-20b")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--cache-len", type=int, default=None)
+    p.add_argument("--prompt", default=None, help="one-shot generation")
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.9)
+    p.add_argument("--repetition-penalty", type=float, default=1.1)
+    p.add_argument("--greedy", action="store_true")
+    p.add_argument("--ui", action="store_true", help="launch the Gradio UI")
+    args = p.parse_args(argv)
+
+    gen = _build_generator(args)
+    if args.ui:
+        _ui(gen)
+    elif args.prompt is not None:
+        sys.stdout.write(
+            gen(
+                args.prompt,
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
+                repetition_penalty=args.repetition_penalty,
+                greedy=args.greedy,
+            )
+            + "\n"
+        )
+    else:
+        _repl(gen, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -117,7 +117,13 @@ class Trainer:
             state = init_train_state(
                 self.model, self.tx, self.rng, self.mesh, self.sample_shape, self.plan
             )
-            if ck.warm_init and ck.warm_init_dir:
+            if ck.warm_init and ck.warm_init_msgpack:
+                params = self._warm_params_from_msgpack(ck.warm_init_msgpack)
+                state = TrainState(
+                    step=state.step, params=params, opt_state=state.opt_state
+                )
+                log.info("warm-initialized params from %s", ck.warm_init_msgpack)
+            elif ck.warm_init and ck.warm_init_dir:
                 donor = ckpt_lib.CheckpointManager(ck.warm_init_dir, keep=1)
                 abstract = self.abstract_state()
                 params = donor.restore_params(abstract.params)
@@ -127,6 +133,46 @@ class Trainer:
                 log.info("warm-initialized params from %s", ck.warm_init_dir)
         self.state = state
         return state
+
+    def _warm_params_from_msgpack(self, path: str):
+        """Load donor params, auto-extend depth / convert layer layout to this
+        model, and place into the plan's shardings (the reference's scale-up
+        warm start, reference ``main_zero.py:268-289`` + ``extend_params.py``)."""
+        from zero_transformer_tpu.utils import surgery
+
+        donor = ckpt_lib.import_params_msgpack(path)
+        if surgery.num_layers(donor) != self.cfg.model.n_layers:
+            donor = surgery.extend_depth(donor, self.cfg.model.n_layers)
+        if surgery.is_stacked(donor) != self.cfg.model.scan_layers:
+            donor = (
+                surgery.stack_blocks(donor)
+                if self.cfg.model.scan_layers
+                else surgery.unstack_blocks(donor)
+            )
+        abstract = self.abstract_state().params
+        donor_struct = jax.tree.structure(donor)
+        if donor_struct != jax.tree.structure(abstract):
+            raise ValueError(
+                f"warm-init donor structure does not match model "
+                f"{self.cfg.model.name!r} after surgery: {path}"
+            )
+        for (kp, d), (_, t) in zip(
+            jax.tree_util.tree_flatten_with_path(donor)[0],
+            jax.tree_util.tree_flatten_with_path(abstract)[0],
+        ):
+            if tuple(d.shape) != tuple(t.shape):
+                name = "/".join(str(getattr(k, "key", k)) for k in kp)
+                raise ValueError(
+                    f"warm-init donor {path} has {name} shaped {tuple(d.shape)} "
+                    f"but model {self.cfg.model.name!r} expects {tuple(t.shape)}"
+                )
+        return jax.tree.map(
+            lambda leaf, tgt: jax.device_put(
+                jnp.asarray(leaf, tgt.dtype), tgt.sharding
+            ),
+            donor,
+            abstract,
+        )
 
     # -- loops --------------------------------------------------------------
 

@@ -1,0 +1,89 @@
+"""Cluster health check: verify every device/host still participates in
+collectives.
+
+Modern-API re-think of the reference's manual smoke script (reference
+``src/utils/pod_test.py:1-34``: global + local ``pmap(psum)``, with the
+documented failure mode of hung processes needing ``pkill``). Here:
+
+- the global check is a jitted ``psum`` under ``shard_map`` over a 1-D mesh of
+  every device — the same ICI/DCN all-reduce a training step issues;
+- the local check sums over this process's devices only;
+- both verify the *value* (device count), so a silently dropped participant
+  is caught, and a wall-clock timeout turns a hang into a diagnosis instead
+  of a mystery (``pod_check(timeout)`` runs the collective in a worker thread).
+
+Usage: ``python -m zero_transformer_tpu.utils.pod_check [--timeout 60]``.
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def _allreduce_count(devices) -> float:
+    """psum of ones over a 1-D mesh of ``devices`` — returns the device count
+    as seen by the collective (must equal ``len(devices)``)."""
+    mesh = Mesh(np.asarray(devices), ("all",))
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("all"), out_specs=P(), check_rep=False
+    )
+    def count(x):
+        return jax.lax.psum(jnp.sum(x), "all")
+
+    ones = jax.device_put(
+        jnp.ones((len(devices),), jnp.float32),
+        jax.sharding.NamedSharding(mesh, P("all")),
+    )
+    return float(count(ones))
+
+
+def pod_check(timeout: float = 60.0, verbose: bool = True) -> bool:
+    """Run global + local collective checks. Returns True when healthy."""
+
+    def run() -> tuple[float, float]:
+        global_count = _allreduce_count(jax.devices())
+        local_count = _allreduce_count(jax.local_devices())
+        return global_count, local_count
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(run)
+        try:
+            global_count, local_count = fut.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            if verbose:
+                print(
+                    f"UNHEALTHY: collective did not complete within {timeout:.0f}s "
+                    "— a host or device is hung (the reference's documented "
+                    "remedy: kill stray processes on every host and restart, "
+                    "pod_test.py:1-6)"
+                )
+            return False
+
+    ok = global_count == jax.device_count() and local_count == jax.local_device_count()
+    if verbose:
+        state = "healthy" if ok else "UNHEALTHY"
+        print(
+            f"{state}: global allreduce saw {global_count:.0f}/{jax.device_count()} "
+            f"devices; local saw {local_count:.0f}/{jax.local_device_count()} "
+            f"(process {jax.process_index()}/{jax.process_count()})"
+        )
+    return ok
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="zero_transformer_tpu.utils.pod_check")
+    p.add_argument("--timeout", type=float, default=60.0)
+    args = p.parse_args(argv)
+    raise SystemExit(0 if pod_check(args.timeout) else 1)
+
+
+if __name__ == "__main__":
+    main()

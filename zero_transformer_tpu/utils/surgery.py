@@ -1,0 +1,102 @@
+"""Model surgery: depth-wise warm-start extension and layer-layout conversion.
+
+Re-implements the reference's Gopher §G.3.3 scale-up path (reference
+``src/utils/extend_params.py:12-49``: duplicate each of N trained blocks into
+k·N consecutive blocks — mapping {i: [2i, 2i+1]} for doubling — and copy
+embeddings / final LN unchanged), used there to warm-start 760M from 580M and
+1.1B from 760M (reference ``logs/760.md:5-10``).
+
+Two layouts are supported because the models compile either way:
+- **stacked** (``scan_layers=True``): block params are [n_layers, ...] leaves
+  under ``blocks`` — extension is a ``jnp.repeat`` on axis 0;
+- **per-block** (``scan_layers=False``): ``block_0`` … ``block_{N-1}``
+  subtrees — extension copies subtrees.
+
+``stack_blocks`` / ``unstack_blocks`` convert between them so checkpoints
+trained one way restore into models compiled the other way.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_PREFIX = "block_"
+_STACKED_KEY = "blocks"
+
+
+def is_stacked(params: Dict[str, Any]) -> bool:
+    return _STACKED_KEY in params
+
+
+def _block_keys(params: Dict[str, Any]) -> list:
+    keys = sorted(
+        (k for k in params if k.startswith(_BLOCK_PREFIX)),
+        key=lambda k: int(k[len(_BLOCK_PREFIX) :]),
+    )
+    if not keys:
+        raise ValueError("no block_<i> subtrees found (already stacked?)")
+    return keys
+
+
+def stack_blocks(params: Dict[str, Any]) -> Dict[str, Any]:
+    """per-block layout → stacked [n_layers, ...] layout."""
+    if is_stacked(params):
+        return params
+    keys = _block_keys(params)
+    blocks = [params[k] for k in keys]
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *blocks)
+    out = {k: v for k, v in params.items() if not k.startswith(_BLOCK_PREFIX)}
+    out[_STACKED_KEY] = stacked
+    return out
+
+
+def unstack_blocks(params: Dict[str, Any]) -> Dict[str, Any]:
+    """stacked layout → per-block layout."""
+    if not is_stacked(params):
+        return params
+    stacked = params[_STACKED_KEY]
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    out = {k: v for k, v in params.items() if k != _STACKED_KEY}
+    for i in range(n):
+        out[f"{_BLOCK_PREFIX}{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return out
+
+
+def num_layers(params: Dict[str, Any]) -> int:
+    if is_stacked(params):
+        return jax.tree.leaves(params[_STACKED_KEY])[0].shape[0]
+    return len(_block_keys(params))
+
+
+def extend_depth(params: Dict[str, Any], n_new: int) -> Dict[str, Any]:
+    """Depth-wise warm start: N trained blocks → n_new = k·N blocks.
+
+    Block i of the donor becomes blocks [k·i, k·i+1, …, k·i+k-1] of the new
+    model (the reference's ``create_mapping`` {i: [2i, 2i+1]} generalized to
+    any integer factor, reference ``extend_params.py:46-49``); all non-block
+    params (wte, wpe, final LN) are copied unchanged (``extend_params.py:20-26``).
+    Preserves the input layout (stacked stays stacked).
+    """
+    n_old = num_layers(params)
+    if n_new % n_old:
+        raise ValueError(
+            f"new depth {n_new} must be an integer multiple of donor depth {n_old}"
+        )
+    factor = n_new // n_old
+    if factor == 1:
+        return params
+    if is_stacked(params):
+        out = dict(params)
+        out[_STACKED_KEY] = jax.tree.map(
+            lambda x: jnp.repeat(x, factor, axis=0), params[_STACKED_KEY]
+        )
+        return out
+    out = {k: v for k, v in params.items() if not k.startswith(_BLOCK_PREFIX)}
+    for i, key in enumerate(_block_keys(params)):
+        for j in range(factor):
+            out[f"{_BLOCK_PREFIX}{factor * i + j}"] = jax.tree.map(
+                lambda x: x, params[key]
+            )
+    return out
