@@ -64,6 +64,8 @@ class Attention(nn.Module):
     deterministic: bool = True
     decode: bool = False
     cache_len: Optional[int] = None  # KV cache capacity; defaults to cfg.max_seq_len
+    # mesh with an active `sequence` axis → ring attention (context parallel)
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -118,6 +120,12 @@ class Attention(nn.Module):
                 q_offset=offset,
                 segment_ids=jnp.broadcast_to(kv_valid[None, :], (B, ck.value.shape[1])),
             )
+        elif self.mesh is not None:
+            from zero_transformer_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, self.mesh, causal=True, alibi=cfg.position == "alibi"
+            )
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, alibi=cfg.position == "alibi", impl=cfg.attention_impl
@@ -156,11 +164,14 @@ class Block(nn.Module):
     deterministic: bool = True
     decode: bool = False
     cache_len: Optional[int] = None
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, _=None):
         cfg = self.cfg
-        x = x + Attention(cfg, self.deterministic, self.decode, self.cache_len, name="attn")(
+        x = x + Attention(
+            cfg, self.deterministic, self.decode, self.cache_len, self.mesh, name="attn"
+        )(
             _norm(cfg, x.dtype, "ln_attn")(x)
         )
         x = x + MLP(cfg, self.deterministic, name="mlp")(
@@ -175,6 +186,9 @@ class Transformer(nn.Module):
     cfg: ModelConfig
     decode: bool = False
     cache_len: Optional[int] = None
+    # mesh with sequence axis > 1 routes attention through ring attention
+    # (context parallelism); None = single-chip / GSPMD-only layouts
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -238,11 +252,14 @@ class Transformer(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, not train, self.decode, self.cache_len, name="blocks")
+            )(cfg, not train, self.decode, self.cache_len, self.mesh, name="blocks")
             h, _ = stack(h, None)
         else:
             for i in range(cfg.n_layers):
-                h, _ = block_cls(cfg, not train, self.decode, self.cache_len, name=f"block_{i}")(h, None)
+                h, _ = block_cls(
+                    cfg, not train, self.decode, self.cache_len, self.mesh,
+                    name=f"block_{i}",
+                )(h, None)
 
         h = _norm(cfg, h.dtype, "ln_f")(h)
 

@@ -2,13 +2,15 @@
 
 Blockwise online-softmax attention that never materializes the [T, T] score
 matrix the reference allocates in full (reference ``src/models/layers.py:159-173``).
-Supports causal masking, ALiBi bias (reference ``layers.py:17-44``), and
-grouped-query attention; softmax statistics are carried in float32 — the dtype
-discipline the reference adopted after its bf16-softmax quality bug
-(reference ``logs/580.md:94-98``).
+Supports causal masking, ALiBi bias (reference ``layers.py:17-44``),
+grouped-query attention, and **global position offsets** so the same kernels
+serve ring attention (``ops/ring_attention.py``), where each device's q / kv
+shard starts at a different absolute position. Softmax statistics are carried
+in float32 — the dtype discipline the reference adopted after its bf16-softmax
+quality bug (reference ``logs/580.md:94-98``).
 
 Kernels run on a [B, H, T, D] layout (Mosaic requires the blocked time axis in
-the sublane position); the public wrapper transposes from the model's
+the sublane position); the public wrappers transpose from the model's
 [B, T, H, D] at the boundary — XLA fuses these transposes into neighboring
 ops. The grid walks (batch, head, q-block, k-block) with the online-softmax
 state (m, l, acc) carried in VMEM scratch across the innermost k-block
@@ -16,11 +18,17 @@ dimension; causally-skipped blocks are predicated off with ``pl.when``. The
 backward pass is two more kernels over the same tiling: one carrying dq across
 k-blocks, one carrying (dk, dv) across q-blocks, both recomputing
 p = exp(s - lse) from the forward's saved logsumexp.
+
+Three entry points:
+- ``flash_attention``      — differentiable, self-contained (custom VJP);
+- ``flash_partial``        — forward returning (out, lse); building block for
+                             cross-device softmax merges (ring attention);
+- ``flash_grads``          — backward given a (possibly *global*) lse/out.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,40 +46,62 @@ DEFAULT_BLOCK_K = 512
 _INIT_M = -1e30  # below any finite score; never produced by real inputs
 
 
-def _bias_block(
-    slope, i, j, block_q: int, block_k: int, alibi: bool, causal: bool
-):
-    """f32 additive bias for score block (i, j): ALiBi distance + causal mask.
+def pick_block(n: int, prefer: int) -> Optional[int]:
+    """Largest block <= prefer (>=128) dividing n, or None if none exists.
+
+    Shared by the wrappers and the dispatch gate (``ops.flash_attention``) so
+    "supported" and "will actually run" can never disagree."""
+    b = min(prefer, n)
+    while b >= 128:
+        if n % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def _bias_block(slope, q_pos0, k_pos0, block_q: int, block_k: int, alibi, causal):
+    """f32 additive bias for one score block whose first q/k global positions
+    are ``q_pos0`` / ``k_pos0`` (traced scalars under ring attention).
 
     Matches ``ops.positions.alibi_bias`` / ``causal_mask_bias`` exactly
-    (distance clamped at 0, mask additive NEG_INF) so the kernel is
-    numerically interchangeable with the XLA path.
-    """
-    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    (distance clamped at 0, mask additive NEG_INF) so the kernels are
+    numerically interchangeable with the XLA path."""
+    q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    dist = q_pos - k_pos
     bias = jnp.zeros((block_q, block_k), jnp.float32)
     if alibi:
-        dist = jnp.maximum(q_pos - k_pos, 0).astype(jnp.float32)
-        bias = bias - slope * dist
+        bias = bias - slope * jnp.maximum(dist, 0).astype(jnp.float32)
     if causal:
-        bias = bias + jnp.where(k_pos <= q_pos, 0.0, NEG_INF).astype(jnp.float32)
+        bias = bias + jnp.where(dist >= 0, 0.0, NEG_INF).astype(jnp.float32)
     return bias
 
 
-def _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j):
+def _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j):
     """[block_q, block_k] f32 score block shared by all three kernels."""
     q = q_ref[0, 0, :, :]
     k = k_ref[0, 0, :, :]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
+    q_pos0 = offs_ref[0, 0] + i * q.shape[0]
+    k_pos0 = offs_ref[1, 0] + j * k.shape[0]
     return s * scale + _bias_block(
-        slope, i, j, q.shape[0], k.shape[0], alibi, causal
+        slope, q_pos0, k_pos0, q.shape[0], k.shape[0], alibi, causal
     )
 
 
+def _run_predicate(offs_ref, i, j, block_q: int, block_k: int, causal: bool):
+    """Does block (i, j) contain any causally-visible entry?"""
+    if not causal:
+        return True
+    first_k = offs_ref[1, 0] + j * block_k
+    last_q = offs_ref[0, 0] + i * block_q + block_q - 1
+    return first_k <= last_q
+
+
 def _fwd_kernel(
-    slope_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    slope_ref, offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, alibi: bool, n_k: int,
 ):
@@ -85,12 +115,9 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: block (i, j) contributes iff some k_pos <= some q_pos
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
-
-    @pl.when(run)
+    @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
     def _compute():
-        s = _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j)
+        s = _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j)
         v = v_ref[0, 0, :, :]
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -102,14 +129,9 @@ def _fwd_kernel(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    # i is a traced grid index: compute the last contributing j dynamically.
-    last = (
-        jnp.minimum(((i + 1) * block_q - 1) // block_k, n_k - 1)
-        if causal
-        else n_k - 1
-    )
-
-    @pl.when(j == last)
+    # the grid's k dimension is innermost-sequential: the final j visit for
+    # this (b, h, i) is always j == n_k-1, even when it was causally skipped
+    @pl.when(j == n_k - 1)
     def _write():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -118,7 +140,7 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    slope_ref, offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_scr,
     *, scale: float, causal: bool, alibi: bool, n_k: int,
 ):
@@ -130,11 +152,9 @@ def _dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
-
-    @pl.when(run)
+    @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
     def _compute():
-        s = _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j)
+        s = _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j)
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
@@ -147,19 +167,14 @@ def _dq_kernel(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    last = (
-        jnp.minimum(((i + 1) * block_q - 1) // block_k, n_k - 1)
-        if causal
-        else n_k - 1
-    )
-
-    @pl.when(j == last)
+    @pl.when(j == n_k - 1)
     def _write():
         dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
-    slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    slope_ref, offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
     dk_scr, dv_scr,
     *, scale: float, causal: bool, alibi: bool, n_q: int,
 ):
@@ -173,11 +188,9 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
-
-    @pl.when(run)
+    @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
     def _compute():
-        s = _scores(slope, q_ref, k_ref, scale, alibi, causal, i, j)
+        s = _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j)
         q = q_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
@@ -199,26 +212,24 @@ def _dkv_kernel(
         dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def pick_block(n: int, prefer: int) -> Optional[int]:
-    """Largest block <= prefer (>=128) dividing n, or None if none exists.
-
-    Shared by the wrapper and the dispatch gate (``ops.flash_attention``) so
-    "supported" and "will actually run" can never disagree."""
-    b = min(prefer, n)
-    while b >= 128:
-        if n % b == 0:
-            return b
-        b //= 2
-    return None
-
-
 def _slopes_arg(n_heads: int, alibi: bool) -> jax.Array:
     if alibi:
         return alibi_slopes(n_heads).reshape(n_heads, 1)
     return jnp.zeros((n_heads, 1), jnp.float32)
 
 
-def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
+def _offsets_arg(q_offset, kv_offset) -> jax.Array:
+    return jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+    ).reshape(2, 1)
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM if pltpu else None)
+
+
+def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
+         q_offset=0, kv_offset=0, slopes=None, out_dtype=None):
     # [B, T, H, D] → [B, H, T, D]: Mosaic needs the blocked time axis in the
     # sublane position
     q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
@@ -227,7 +238,8 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
     G = H // KVH
     n_q, n_k = T // block_q, S // block_k
 
-    slope_spec = pl.BlockSpec(memory_space=pltpu.SMEM if pltpu else None)
+    if slopes is None:
+        slopes = _slopes_arg(H, alibi)
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
     o, lse = pl.pallas_call(
@@ -235,13 +247,13 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
             _fwd_kernel, scale=scale, causal=causal, alibi=alibi, n_k=n_k
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[slope_spec, q_spec, kv_spec, kv_spec],
+        in_specs=[_smem_spec(), _smem_spec(), q_spec, kv_spec, kv_spec],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
             jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -250,20 +262,24 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, D), jnp.float32),  # acc
         ],
         interpret=interpret,
-    )(_slopes_arg(H, alibi), q, k, v)
+    )(slopes, _offsets_arg(q_offset, kv_offset), q, k, v)
     return jnp.swapaxes(o, 1, 2), lse
 
 
-def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret):
+def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
+         q_offset=0, kv_offset=0, slopes=None, grad_dtype=None, delta=None):
     q, k, v, o, do = (jnp.swapaxes(x, 1, 2) for x in (q, k, v, o, do))
     B, H, T, D = q.shape
     _, KVH, S, _ = k.shape
     G = H // KVH
     n_q, n_k = T // block_q, S // block_k
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None]  # [B,H,T,1]
+    if delta is None:  # rowsum(do * o) — loop-invariant for ring callers
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None]
 
-    slope_spec = pl.BlockSpec(memory_space=pltpu.SMEM if pltpu else None)
+    if slopes is None:
+        slopes = _slopes_arg(H, alibi)
+    offs = _offsets_arg(q_offset, kv_offset)
     q_spec_iq = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec_iq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
     row_spec_iq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
@@ -273,12 +289,13 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret)
             _dq_kernel, scale=scale, causal=causal, alibi=alibi, n_k=n_k
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[slope_spec, q_spec_iq, kv_spec_iq, kv_spec_iq, q_spec_iq, row_spec_iq, row_spec_iq],
+        in_specs=[_smem_spec(), _smem_spec(), q_spec_iq, kv_spec_iq, kv_spec_iq,
+                  q_spec_iq, row_spec_iq, row_spec_iq],
         out_specs=q_spec_iq,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, grad_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(_slopes_arg(H, alibi), q, k, v, do, lse, delta)
+    )(slopes, offs, q, k, v, do, lse, delta)
 
     # k-block-major grid; q walked innermost. dk/dv computed per *query* head
     # ([B, H, S, D]) then group-summed to KVH for GQA.
@@ -291,25 +308,26 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret)
             _dkv_kernel, scale=scale, causal=causal, alibi=alibi, n_q=n_q
         ),
         grid=(B, H, n_k, n_q),
-        in_specs=[slope_spec, q_spec_jq, kv_spec_jq, kv_spec_jq, q_spec_jq, row_spec_jq, row_spec_jq],
+        in_specs=[_smem_spec(), _smem_spec(), q_spec_jq, kv_spec_jq, kv_spec_jq,
+                  q_spec_jq, row_spec_jq, row_spec_jq],
         out_specs=[kv_out_jq, kv_out_jq],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), grad_dtype or k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), grad_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(_slopes_arg(H, alibi), q, k, v, do, lse, delta)
+    )(slopes, offs, q, k, v, do, lse, delta)
 
     dq = jnp.swapaxes(dq, 1, 2)
     dk = jnp.swapaxes(dk, 1, 2)  # [B, S, H, D]
     dv = jnp.swapaxes(dv, 1, 2)
     if G > 1:
-        dk = dk.reshape(B, S, KVH, G, D).sum(axis=3).astype(k.dtype)
-        dv = dv.reshape(B, S, KVH, G, D).sum(axis=3).astype(v.dtype)
+        dk = dk.reshape(B, S, KVH, G, D).sum(axis=3).astype(grad_dtype or k.dtype)
+        dv = dv.reshape(B, S, KVH, G, D).sum(axis=3).astype(grad_dtype or v.dtype)
     return dq, dk, dv
 
 
@@ -332,6 +350,17 @@ def _flash_bwd(causal, alibi, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _resolve_blocks(T, S, block, block_q, block_k):
+    block_q = block_q or block or pick_block(T, DEFAULT_BLOCK_Q) or DEFAULT_BLOCK_Q
+    block_k = block_k or block or pick_block(S, DEFAULT_BLOCK_K) or DEFAULT_BLOCK_K
+    block_q, block_k = min(block_q, T), min(block_k, S)
+    if T % block_q or S % block_k:
+        raise ValueError(
+            f"seq lengths ({T}, {S}) not divisible by blocks ({block_q}, {block_k})"
+        )
+    return block_q, block_k
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -350,12 +379,47 @@ def flash_attention(
     _, S, KVH, _ = k.shape
     if H % KVH:
         raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
-    block_q = block_q or block or pick_block(T, DEFAULT_BLOCK_Q) or DEFAULT_BLOCK_Q
-    block_k = block_k or block or pick_block(S, DEFAULT_BLOCK_K) or DEFAULT_BLOCK_K
-    block_q, block_k = min(block_q, T), min(block_k, S)
-    if T % block_q or S % block_k:
-        raise ValueError(
-            f"seq lengths ({T}, {S}) not divisible by blocks ({block_q}, {block_k})"
-        )
+    block_q, block_k = _resolve_blocks(T, S, block, block_q, block_k)
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
     return _flash(q, k, v, causal, alibi, float(scale), block_q, block_k, interpret)
+
+
+def flash_partial(
+    q, k, v, *, causal, alibi, softmax_scale, q_offset, kv_offset,
+    slopes=None, block: Optional[int] = None, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Forward-only: (out [B,T,H,D], lse [B,H,T,1]) at global offsets.
+
+    ``out`` is normalized by the LOCAL softmax sum; merge across kv shards
+    with the lse (ring attention does this). ``slopes`` overrides the ALiBi
+    slope table for head-sharded (TP) calls. NOT differentiable — pair with
+    ``flash_grads`` under a custom VJP.
+    """
+    B, T, H, D = q.shape
+    _, S, KVH, _ = k.shape
+    block_q, block_k = _resolve_blocks(T, S, block, None, None)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
+    return _fwd(
+        q, k, v, causal, alibi, float(scale), block_q, block_k, interpret,
+        q_offset=q_offset, kv_offset=kv_offset, slopes=slopes,
+        out_dtype=jnp.float32,  # merged (and rounded once) by the caller
+    )
+
+
+def flash_grads(
+    q, k, v, o, lse, do, *, causal, alibi, softmax_scale, q_offset, kv_offset,
+    slopes=None, delta=None, block: Optional[int] = None, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(dq, dk, dv) given the GLOBAL (out, lse) of the merged softmax —
+    the flash backward identity p = exp(s - lse_global) makes per-shard
+    backward passes independent (ring attention sums them)."""
+    B, T, H, D = q.shape
+    _, S, KVH, _ = k.shape
+    block_q, block_k = _resolve_blocks(T, S, block, None, None)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
+    return _bwd(
+        q, k, v, o, lse, do, causal, alibi, float(scale), block_q, block_k,
+        interpret, q_offset=q_offset, kv_offset=kv_offset, slopes=slopes,
+        grad_dtype=jnp.float32,  # summed across ring steps by the caller
+        delta=delta,
+    )

@@ -1,0 +1,362 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+The reference has NO sequence/context parallelism — its long-context story is
+ALiBi length extrapolation plus *reducing* context to dodge OOM (reference
+``src/models/layers.py:80-101``, ``logs/1B.md:7``; SURVEY §2 checklist). This
+module adds the TPU-native mechanism: activations stay sharded [B, T/n, H, D]
+over the ``sequence`` mesh axis; K/V shards rotate around the ring with
+``lax.ppermute`` (ICI neighbor exchange) while each device folds one KV shard
+per step into an online-softmax merge. Attention is exact (same numerics as a
+full all-gather) but peak memory per chip stays at one KV shard per in-flight
+step and the transfers overlap with the block compute.
+
+Two inner engines:
+
+- **flash** (default on TPU): each ring step is one Pallas flash-attention
+  call at the shard's global position offsets (``ops/pallas/flash.py
+  flash_partial``), merged across steps by logsumexp weights; the backward is
+  a ring of ``flash_grads`` calls against the GLOBAL lse (the flash identity
+  p = exp(s - lse) makes per-shard backwards independent), with (dk, dv)
+  accumulators riding the same ppermute ring home to their owners. HBM per
+  step stays at flash-kernel level — no [t, t] score matrix ever exists.
+- **xla** fallback (CPU tests, unsupported shapes): the same merge with plain
+  einsums, rematerialized per step via ``jax.checkpoint``.
+
+Global-view entry: ``ring_attention(q, k, v, mesh, ...)`` wraps the SPMD body
+in ``shard_map`` with specs derived from the mesh (batch over data/fsdp axes,
+sequence over ``sequence``, heads over ``tensor``), so it drops into a jitted
+train step like any other op.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from zero_transformer_tpu.ops.positions import NEG_INF, alibi_slopes
+from zero_transformer_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+)
+
+_INIT_M = -1e30
+
+
+# -- shared helpers -----------------------------------------------------------
+
+
+def _specs(mesh: Mesh, B: int, tp: int):
+    batch_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if mesh.shape.get(a, 1) > 1)
+    # keep only batch axes whose product divides B (small eval batches stay
+    # replicated rather than erroring)
+    while batch_axes and B % math.prod(mesh.shape[a] for a in batch_axes):
+        batch_axes = batch_axes[:-1]
+    head_axis = TENSOR_AXIS if tp > 1 else None
+    qkv = P(batch_axes or None, SEQUENCE_AXIS, head_axis, None)
+    lse = P(batch_axes or None, head_axis, SEQUENCE_AXIS, None)
+    return qkv, lse
+
+
+def _local_slopes(H_global: int, H_local: int, tp: int, alibi: bool):
+    """[H_local, 1] ALiBi slope table for this tensor-parallel shard (zeros
+    when ALiBi is off — the kernels ignore it then)."""
+    if not alibi:
+        return jnp.zeros((H_local, 1), jnp.float32)
+    all_slopes = alibi_slopes(H_global)
+    if tp > 1:
+        h_off = jax.lax.axis_index(TENSOR_AXIS) * H_local
+        return jax.lax.dynamic_slice_in_dim(all_slopes, h_off, H_local).reshape(
+            H_local, 1
+        )
+    return all_slopes.reshape(H_local, 1)
+
+
+def _rotate(x, axis_name: str, n: int):
+    return jax.lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
+
+
+# -- flash-backed ring (custom VJP) ------------------------------------------
+
+
+def _ring_flash_fwd_body(q, k, v, *, n, tp, H, causal, alibi, scale, interpret):
+    from zero_transformer_tpu.ops.pallas.flash import flash_partial
+
+    B, t_q, H_l, D = q.shape
+    my = jax.lax.axis_index(SEQUENCE_AXIS)
+    q_off = my * t_q
+    t_kv = k.shape[1]
+    slopes = _local_slopes(H, H_l, tp, alibi)
+
+    def fold(m, norm, acc, k_cur, v_cur, src):
+        o_i, lse_i = flash_partial(
+            q, k_cur, v_cur,
+            causal=causal, alibi=alibi, softmax_scale=scale,
+            q_offset=q_off, kv_offset=src * t_kv, slopes=slopes,
+            interpret=interpret,
+        )
+        lse_i = lse_i[..., 0]  # [B, H_l, t_q]
+        m_new = jnp.maximum(m, lse_i)
+        w_prev = jnp.exp(m - m_new)
+        w_i = jnp.exp(lse_i - m_new)
+        norm_new = norm * w_prev + w_i
+        wp = jnp.transpose(w_prev, (0, 2, 1))[..., None]  # [B, t_q, H_l, 1]
+        wi = jnp.transpose(w_i, (0, 2, 1))[..., None]
+        return m_new, norm_new, acc * wp + o_i * wi
+
+    def step(carry, _):
+        m, norm, acc, k_cur, v_cur, src = carry
+        m, norm, acc = fold(m, norm, acc, k_cur, v_cur, src)
+        return (
+            m, norm, acc,
+            _rotate(k_cur, SEQUENCE_AXIS, n), _rotate(v_cur, SEQUENCE_AXIS, n),
+            (src - 1) % n,
+        ), None
+
+    m0 = jnp.full((B, H_l, t_q), _INIT_M, jnp.float32)
+    n0 = jnp.zeros((B, H_l, t_q), jnp.float32)
+    a0 = jnp.zeros((B, t_q, H_l, D), jnp.float32)
+    # n-1 rotated steps + a final fold without the (discarded) last rotation
+    (m, norm, acc, k_last, v_last, src), _ = jax.lax.scan(
+        step, (m0, n0, a0, k, v, my), None, length=n - 1
+    )
+    m, norm, acc = fold(m, norm, acc, k_last, v_last, src)
+    norm_safe = jnp.where(norm == 0.0, 1.0, norm)
+    out = acc / jnp.transpose(norm_safe, (0, 2, 1))[..., None]
+    lse = (m + jnp.log(norm_safe))[..., None]  # [B, H_l, t_q, 1]
+    return out.astype(q.dtype), lse
+
+
+def _ring_flash_bwd_body(q, k, v, o, lse, do, *, n, tp, H, causal, alibi, scale, interpret):
+    from zero_transformer_tpu.ops.pallas.flash import flash_grads
+
+    B, t_q, H_l, D = q.shape
+    my = jax.lax.axis_index(SEQUENCE_AXIS)
+    q_off = my * t_q
+    t_kv = k.shape[1]
+    slopes = _local_slopes(H, H_l, tp, alibi)
+    # rowsum(do * o) is identical for every ring step — compute it once,
+    # in the kernels' [B, H, T, 1] layout
+    delta = jnp.swapaxes(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1), 1, 2
+    )[..., None]
+
+    def grads_at(dq, dk_rot, dv_rot, k_cur, v_cur, src):
+        dq_i, dk_i, dv_i = flash_grads(
+            q, k_cur, v_cur, o, lse, do,
+            causal=causal, alibi=alibi, softmax_scale=scale,
+            q_offset=q_off, kv_offset=src * t_kv, slopes=slopes, delta=delta,
+            interpret=interpret,
+        )
+        return dq + dq_i, dk_rot + dk_i, dv_rot + dv_i
+
+    def step(carry, _):
+        dq, dk_rot, dv_rot, k_cur, v_cur, src = carry
+        dq, dk_rot, dv_rot = grads_at(dq, dk_rot, dv_rot, k_cur, v_cur, src)
+        # (dk, dv) accumulators ride the ring WITH their kv shard; after the
+        # final rotation they land back on the shard's owner
+        return (
+            dq,
+            _rotate(dk_rot, SEQUENCE_AXIS, n), _rotate(dv_rot, SEQUENCE_AXIS, n),
+            _rotate(k_cur, SEQUENCE_AXIS, n), _rotate(v_cur, SEQUENCE_AXIS, n),
+            (src - 1) % n,
+        ), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dkv0 = jnp.zeros(k.shape, jnp.float32)
+    (dq, dk, dv, k_last, v_last, src), _ = jax.lax.scan(
+        step, (dq0, dkv0, dkv0, k, v, my), None, length=n - 1
+    )
+    # final step: fold the last shard, then rotate ONLY the grad accumulators
+    # (the kv rotation would be discarded)
+    dq, dk, dv = grads_at(dq, dk, dv, k_last, v_last, src)
+    dk = _rotate(dk, SEQUENCE_AXIS, n)
+    dv = _rotate(dv, SEQUENCE_AXIS, n)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _ring_flash(q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret):
+    out, _ = _ring_flash_fwd(
+        q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd(q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret):
+    H = q.shape[2]
+    body = functools.partial(
+        _ring_flash_fwd_body,
+        n=n, tp=tp, H=H, causal=causal, alibi=alibi, scale=scale, interpret=interpret,
+    )
+    out, lse = shard_map(
+        body, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=(qkv_spec, lse_spec),
+        check_vma=False,
+    )(q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret, res, do):
+    q, k, v, out, lse = res
+    H = q.shape[2]
+    body = functools.partial(
+        _ring_flash_bwd_body,
+        n=n, tp=tp, H=H, causal=causal, alibi=alibi, scale=scale, interpret=interpret,
+    )
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(qkv_spec,) * 4 + (lse_spec, qkv_spec),
+        out_specs=(qkv_spec, qkv_spec, qkv_spec),
+        check_vma=False,
+    )(q, k, v, out, lse, do)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+# -- XLA fallback ring (autodiff through the scan) ---------------------------
+
+
+def _block_bias(slopes, q_off, kv_off, t_q: int, t_kv: int, causal: bool):
+    """[H|1, t_q, t_kv] f32 bias; offsets may be traced scalars."""
+    q_pos = q_off + jnp.arange(t_q, dtype=jnp.int32)
+    kv_pos = kv_off + jnp.arange(t_kv, dtype=jnp.int32)
+    dist = q_pos[:, None] - kv_pos[None, :]
+    bias = jnp.zeros((1, t_q, t_kv), jnp.float32)
+    if slopes is not None:
+        bias = bias - slopes[:, None, None] * jnp.maximum(dist, 0).astype(jnp.float32)
+    if causal:
+        bias = bias + jnp.where(dist >= 0, 0.0, NEG_INF).astype(jnp.float32)
+    return bias
+
+
+def _ring_xla_body(q, k, v, *, n, tp, H, causal, alibi, scale):
+    """Einsum inner engine: same merge math, full [t_q, t_kv] block per step
+    (rematerialized in the backward via jax.checkpoint)."""
+    B, t_q, H_l, D = q.shape
+    _, t_kv, KVH, _ = k.shape
+    G = H_l // KVH
+    qg = q.reshape(B, t_q, KVH, G, D)
+    my = jax.lax.axis_index(SEQUENCE_AXIS)
+    q_off = my * t_q
+    slopes = _local_slopes(H, H_l, tp, alibi)[:, 0] if alibi else None
+
+    @jax.checkpoint
+    def fold(m, l, acc, k_cur, v_cur, src):
+        bias = _block_bias(slopes, q_off, src * t_kv, t_q, t_kv, causal)
+        s = jnp.einsum(
+            "btkgd,bskd->bkgts", qg, k_cur, preferred_element_type=jnp.float32
+        )
+        s = s * jnp.float32(scale)
+        if bias.shape[0] == 1:
+            s = s + bias[None, :, None]
+        else:
+            s = s + bias.reshape(1, KVH, G, t_q, t_kv)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        pv = jnp.einsum(
+            "bkgts,bskd->btkgd", p, v_cur, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc * alpha.transpose(0, 3, 1, 2)[..., None] + pv
+
+    def step(carry, _):
+        m, l, acc, k_cur, v_cur, src = carry
+        m, l, acc = fold(m, l, acc, k_cur, v_cur, src)
+        return (
+            m, l, acc,
+            _rotate(k_cur, SEQUENCE_AXIS, n), _rotate(v_cur, SEQUENCE_AXIS, n),
+            (src - 1) % n,
+        ), None
+
+    m0 = jnp.full((B, KVH, G, t_q), _INIT_M, jnp.float32)
+    l0 = jnp.zeros((B, KVH, G, t_q), jnp.float32)
+    a0 = jnp.zeros((B, t_q, KVH, G, D), jnp.float32)
+    # n-1 rotated steps + a final fold without the (discarded) last rotation
+    (m, l, acc, k_last, v_last, src), _ = jax.lax.scan(
+        step, (m0, l0, a0, k, v, my), None, length=n - 1
+    )
+    m, l, acc = fold(m, l, acc, k_last, v_last, src)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(B, t_q, H_l, D).astype(q.dtype)
+
+
+# -- public entry -------------------------------------------------------------
+
+
+def _flash_local_ok(t_local: int, D: int, dtype, interpret: bool) -> bool:
+    from zero_transformer_tpu.ops.pallas.flash import pick_block
+
+    if pick_block(t_local, 512) is None:
+        return False
+    if D % 64 or D > 256:
+        return False
+    if dtype not in (jnp.bfloat16, jnp.float32):
+        return False
+    if not interpret and jax.default_backend() != "tpu":
+        return False
+    return True
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    alibi: bool = False,
+    softmax_scale: Optional[float] = None,
+    impl: str = "auto",  # "auto" | "flash" | "xla"
+    interpret: bool = False,  # run the Pallas engine interpreted (CPU tests)
+) -> jax.Array:
+    """Global-view ring attention. q [B,T,H,D]; k,v [B,T,KVH,D].
+
+    T must divide by the ``sequence`` axis size; heads by the ``tensor`` axis
+    size when that is >1. With sequence=1 this degrades to a single local
+    fold (still correct, but use the flash/XLA paths instead).
+    """
+    B, T, H, D = q.shape
+    _, S, KVH, _ = k.shape
+    if T != S:
+        raise ValueError("ring attention requires q and kv sequence lengths equal")
+    n = mesh.shape[SEQUENCE_AXIS]
+    tp = mesh.shape[TENSOR_AXIS]
+    if T % n:
+        raise ValueError(f"sequence length {T} not divisible by sequence axis {n}")
+    if tp > 1 and (H % tp or KVH % tp):
+        raise ValueError(f"heads ({H}, {KVH}) not divisible by tensor axis {tp}")
+    if H % KVH:
+        raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
+    scale = float(softmax_scale if softmax_scale is not None else 1.0 / (D**0.5))
+    qkv_spec, lse_spec = _specs(mesh, B, tp)
+
+    use_flash = impl in ("auto", "flash") and _flash_local_ok(
+        T // n, D, q.dtype, interpret
+    )
+    if impl == "flash" and not use_flash:
+        raise NotImplementedError(
+            f"flash ring attention unsupported for local shape "
+            f"T/n={T // n}, D={D}, dtype={q.dtype}"
+        )
+    if use_flash:
+        return _ring_flash(
+            q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret
+        )
+
+    body = functools.partial(
+        _ring_xla_body, n=n, tp=tp, H=H, causal=causal, alibi=alibi, scale=scale
+    )
+    return shard_map(
+        body, mesh=mesh, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec, check_vma=False
+    )(q, k, v)

@@ -50,7 +50,12 @@ class Trainer:
         self.zero_stage = cfg.mesh.zero_stage
 
         opt = dataclasses.replace(cfg.optimizer, total_steps=cfg.training.total_steps)
-        self.model = Transformer(cfg.model)
+        # an active sequence axis routes attention through the ring-attention
+        # context-parallel path (ops/ring_attention.py)
+        from zero_transformer_tpu.parallel.mesh import SEQUENCE_AXIS
+
+        seq_parallel = self.mesh.shape[SEQUENCE_AXIS] > 1
+        self.model = Transformer(cfg.model, mesh=self.mesh if seq_parallel else None)
         self.schedule = make_schedule(opt)
         self.tx = make_optimizer(opt, self.schedule)
 

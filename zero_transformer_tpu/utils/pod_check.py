@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def _allreduce_count(devices) -> float:
@@ -33,7 +33,7 @@ def _allreduce_count(devices) -> float:
     mesh = Mesh(np.asarray(devices), ("all",))
 
     @functools.partial(
-        shard_map, mesh=mesh, in_specs=P("all"), out_specs=P(), check_rep=False
+        shard_map, mesh=mesh, in_specs=P("all"), out_specs=P(), check_vma=False
     )
     def count(x):
         return jax.lax.psum(jnp.sum(x), "all")
