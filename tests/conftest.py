@@ -35,3 +35,7 @@ def devices():
 @pytest.fixture(scope="session", autouse=True)
 def _assert_cpu():
     assert jax.default_backend() == "cpu", jax.default_backend()
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test (excluded from quick CI lane)")

@@ -1,0 +1,113 @@
+"""Worker for the 2-process x 4-device multi-host test (test_multihost.py).
+
+Runs as a real separate process: initializes jax.distributed through
+``bootstrap.maybe_initialize`` (env-driven), then exercises every
+multi-process code path the reference only ever ran on live pods
+(reference ``main_zero.py:181-184,377-387,554-557``):
+
+- global device census across processes,
+- ``device_put_batch`` building a global array from process-local rows,
+- a fused ZeRO train step (grad all-reduce crosses the process boundary),
+- multi-process Orbax save + restore,
+- the pod health check.
+
+Prints ``WORKER_OK`` as its last line on success.
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from zero_transformer_tpu.parallel.bootstrap import maybe_initialize  # noqa: E402
+
+
+def main():
+    assert maybe_initialize(), "coordinator env vars must trigger initialization"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+
+    import numpy as np
+    import optax
+
+    from zero_transformer_tpu import checkpoint as ckpt_lib
+    from zero_transformer_tpu.config import MeshConfig, OptimizerConfig, model_config
+    from zero_transformer_tpu.data import DataLoader, SyntheticSource, device_put_batch
+    from zero_transformer_tpu.models.gpt import Transformer
+    from zero_transformer_tpu.parallel.mesh import make_mesh
+    from zero_transformer_tpu.parallel.zero import (
+        init_train_state,
+        make_plan,
+        make_train_step,
+    )
+    from zero_transformer_tpu.training.optimizer import make_optimizer
+    from zero_transformer_tpu.utils.pod_check import pod_check
+
+    # health check crosses both processes
+    assert pod_check(timeout=120.0), "pod_check failed"
+
+    cfg = model_config("test", dropout=0.0)
+    mesh = make_mesh(MeshConfig(zero_stage=2))
+    model = Transformer(cfg)
+    tx = make_optimizer(OptimizerConfig(warmup_steps=2, total_steps=10))
+
+    batch_size, seq = 8, 32
+    plan = make_plan(model, tx, mesh, (batch_size, seq), zero_stage=2)
+    state = init_train_state(
+        model, tx, jax.random.PRNGKey(0), mesh, (batch_size, seq), plan
+    )
+    step = make_train_step(model, tx, mesh, plan, zero_stage=2)
+
+    # striped loader -> process-local rows -> global sharded batch
+    loader = DataLoader(
+        SyntheticSource(cfg.vocab_size, seq, seed=1),
+        batch_size=batch_size,
+        train_context=seq,
+    )
+    assert loader.process_count == 2
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_sharding = NamedSharding(mesh, P(None, *plan.batch.spec))
+    rng = jax.random.PRNGKey(2)
+    losses = []
+    it = iter(loader)
+    for _ in range(2):
+        local = next(it)  # [1, local_batch, seq]
+        batch = device_put_batch(local, batch_sharding)
+        assert batch.shape == (1, batch_size, seq)
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    assert all(l == l for l in losses), f"non-finite loss: {losses}"
+    norm_before = float(optax.global_norm(state.params))
+
+    # multi-process Orbax round trip (each host writes only its shards)
+    ckpt_dir = os.environ["WORKER_CKPT_DIR"]
+    mgr = ckpt_lib.CheckpointManager(ckpt_dir, keep=1, async_save=False)
+    mgr.save(2, state, meta={"loader": loader.state()}, force=True)
+    mgr.wait()
+
+    abstract = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        jax.eval_shape(lambda s: s, state),
+        plan.state,
+    )
+    restored, meta = mgr.restore(abstract)
+    assert int(restored.step) == 2
+    assert meta["loader"]["steps_consumed"] == 2
+    norm_after = float(optax.global_norm(restored.params))
+    np.testing.assert_allclose(norm_after, norm_before, rtol=1e-6)
+    mgr.close()
+
+    print(f"process {jax.process_index()}: losses={losses}", flush=True)
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

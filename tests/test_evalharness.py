@@ -100,7 +100,9 @@ def test_perplexity_and_bpb(model_and_params):
     rng = np.random.default_rng(4)
     stream = list(rng.integers(1, 60, 70))
     out = perplexity(model, params, stream, seq_len=16, batch_size=2, num_bytes=300)
-    assert out["tokens"] == 4 * 15  # 4 windows, seq_len-1 targets each
+    # rolling windows (stride seq_len-1): every token but the stream's first
+    # is predicted exactly once
+    assert out["tokens"] == len(stream) - 1
     np.testing.assert_allclose(out["ppl"], math.exp(out["nll"] / out["tokens"]), rtol=1e-6)
     np.testing.assert_allclose(
         out["bits_per_byte"], out["nll"] / (math.log(2) * 300), rtol=1e-6

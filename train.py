@@ -46,18 +46,34 @@ def main():
     parser.add_argument("--resume", action="store_true", default=False)
     parser.add_argument("--wandb", action="store_true", default=False)
     parser.add_argument("--max-steps", type=int, default=None)
+    parser.add_argument(
+        "--profile",
+        type=int,
+        default=None,
+        metavar="N",
+        help="capture a jax.profiler trace of N steps (after the compile step)",
+    )
     parser.add_argument("--set", nargs="*", default=None, metavar="KEY=VALUE")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     from zero_transformer_tpu.config import load_config
+    from zero_transformer_tpu.parallel.bootstrap import maybe_initialize
     from zero_transformer_tpu.training.trainer import Trainer
+
+    # multi-host: wire the DCN coordination service when coordinator env vars
+    # are present (reference ran pods on the implicit runtime, main_zero.py:181-184)
+    maybe_initialize()
 
     cfg = load_config(args.cfg)
     cfg = apply_overrides(cfg, parse_overrides(args.set))
     if args.resume:
         cfg = dataclasses.replace(
             cfg, checkpoint=dataclasses.replace(cfg.checkpoint, resume=True)
+        )
+    if args.profile:
+        cfg = dataclasses.replace(
+            cfg, training=dataclasses.replace(cfg.training, profile_steps=args.profile)
         )
 
     logging.info(

@@ -151,11 +151,17 @@ class TrainingConfig:
     total_steps: int = 163000
     seed: int = 0
     log_frequency: int = 10
+    # capture a jax.profiler trace of this many consecutive steps (0 = off),
+    # starting after the first (compile) step; viewable in TensorBoard/XProf
+    profile_steps: int = 0
+    profile_dir: str = ""  # default: <checkpoint.directory>/profile
 
 
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
-    # "synthetic" | "memmap" | "hf" (datasets streaming)
+    # "synthetic" | "memmap" | "hf" (datasets streaming) | "tar" (webdataset-
+    # style tar shards / *.index files — the reference's actual data path,
+    # main_zero.py:389-421)
     source: str = "synthetic"
     train_path: str = ""
     validation_path: str = ""

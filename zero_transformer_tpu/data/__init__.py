@@ -12,10 +12,18 @@ from zero_transformer_tpu.data.sources import (  # noqa: F401
     TokenSource,
     write_memmap,
 )
+from zero_transformer_tpu.data.tarshards import TarShardSource  # noqa: F401
 
 
-def make_source(cfg: Config, validation: bool = False) -> TokenSource:
+def make_source(
+    cfg: Config,
+    validation: bool = False,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> TokenSource:
     """Build the TokenSource named by ``cfg.data.source``."""
+    import jax
+
     data = cfg.data
     path = data.validation_path if validation else data.train_path
     if data.source == "synthetic":
@@ -33,6 +41,19 @@ def make_source(cfg: Config, validation: bool = False) -> TokenSource:
         )
     if data.source == "hf":
         return HFSource(path, max_context=data.max_context)
+    if data.source == "tar":
+        return TarShardSource(
+            path,
+            max_context=data.max_context,
+            seed=data.shuffle_seed,
+            shuffle_shards=not validation,
+            process_index=(
+                process_index if process_index is not None else jax.process_index()
+            ),
+            process_count=(
+                process_count if process_count is not None else jax.process_count()
+            ),
+        )
     raise ValueError(f"unknown data source {cfg.data.source!r}")
 
 
@@ -42,7 +63,7 @@ def make_loader(
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
 ) -> DataLoader:
-    source = make_source(cfg, validation)
+    source = make_source(cfg, validation, process_index, process_count)
     return DataLoader(
         source,
         batch_size=cfg.training.batch_size,

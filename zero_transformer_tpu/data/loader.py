@@ -63,6 +63,9 @@ class DataLoader:
         self.shuffle_buffer = shuffle_buffer
         self.seed = seed
         self.steps_consumed = 0
+        # A source that stripes itself (e.g. TarShardSource shard striping)
+        # already yields only this process's rows.
+        self.pre_striped = bool(getattr(source, "pre_striped", False))
 
         if source.max_context % self.train_context:
             raise ValueError(
@@ -86,6 +89,9 @@ class DataLoader:
         self.local_batch = batch_size // self.process_count
 
     def _striped_rows(self) -> Iterator[np.ndarray]:
+        if self.pre_striped:
+            yield from iter(self.source)
+            return
         for i, row in enumerate(iter(self.source)):
             if i % self.process_count == self.process_index:
                 yield row
@@ -122,8 +128,10 @@ class DataLoader:
 
     def skip(self, n_steps: int) -> None:
         """Fast-forward past ``n_steps`` batches (resume). Seeks the source in
-        GLOBAL rows so striping stays aligned across processes."""
-        self.source.seek(n_steps * self.rows_per_step * self.process_count)
+        GLOBAL rows so striping stays aligned across processes; a pre-striped
+        source counts positions in its own (local) rows instead."""
+        n = n_steps * self.rows_per_step
+        self.source.seek(n if self.pre_striped else n * self.process_count)
         self.steps_consumed += n_steps
 
     def state(self) -> dict:

@@ -41,6 +41,44 @@ class TokenSource:
         raise NotImplementedError
 
 
+class ReplayStreamSource(TokenSource):
+    """Position tracking for sources that can only resume by replaying their
+    stream from the head and discarding (webdataset-style tars, HF streaming).
+
+    Subclasses implement ``_samples()`` — an infinite iterator over decoded
+    rows from position 0. ``seek`` is O(n) (discard) but exact; repeated
+    ``iter()`` calls CONTINUE the stream (replaying past skip + yielded rows)
+    rather than restarting it, matching the indexable sources' contract.
+    """
+
+    def __init__(self):
+        self._skip_rows = 0
+        self._yielded = 0
+
+    def _samples(self) -> Iterator[np.ndarray]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        start = self._skip_rows + self._yielded
+        skipped = 0
+        for row in self._samples():
+            if skipped < start:
+                skipped += 1
+                continue
+            self._yielded += 1
+            yield row
+
+    def seek(self, n_rows: int) -> None:
+        self._skip_rows += n_rows
+
+    def state(self) -> Dict[str, Any]:
+        return {"rows": self._yielded + self._skip_rows}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        self._skip_rows = int(state["rows"])
+        self._yielded = 0
+
+
 @dataclasses.dataclass
 class SyntheticSource(TokenSource):
     """Deterministic random tokens; row ``i`` is a pure function of (seed, i)."""
@@ -142,13 +180,14 @@ class MemmapSource(TokenSource):
         self._row_in_epoch = int(state["row_in_epoch"])
 
 
-class HFSource(TokenSource):
+class HFSource(ReplayStreamSource):
     """Streaming rows from a HuggingFace dataset of pre-tokenized examples.
 
     Expects each example to carry ``field`` (default ``input_ids``) holding at
     least ``max_context`` token ids (extra ids are truncated — the reference's
-    preprocess did the same, ``main_zero.py:368-373``). ``seek`` discards
-    (O(n)) since the stream is not indexable.
+    preprocess did the same, ``main_zero.py:368-373``). Positions are counted
+    in YIELDED rows (length-filtered examples don't count), replayed
+    deterministically by ``ReplayStreamSource``.
     """
 
     def __init__(
@@ -161,39 +200,19 @@ class HFSource(TokenSource):
     ):
         import datasets  # gated: heavy import
 
+        super().__init__()
         self.max_context = max_context
         self.field = field
-        # position is counted in YIELDED rows everywhere (state/seek/restore);
-        # the raw-example counter exists only to replay the stream past
-        # length-filtered examples deterministically.
-        self._skip_rows = 0
-        self._yielded = 0
         self._ds = datasets.load_dataset(
             name_or_path, split=split, streaming=True, **load_kwargs
         )
 
-    def __iter__(self) -> Iterator[np.ndarray]:
-        it = iter(self._ds)
-        skipped = 0
-        for ex in it:
+    def _samples(self) -> Iterator[np.ndarray]:
+        for ex in iter(self._ds):
             ids = np.asarray(ex[self.field], dtype=np.int32)
             if len(ids) < self.max_context:
                 continue  # filtered examples don't count as rows
-            if skipped < self._skip_rows:
-                skipped += 1
-                continue
-            self._yielded += 1
             yield ids[: self.max_context]
-
-    def seek(self, n_rows: int) -> None:
-        self._skip_rows += n_rows
-
-    def state(self) -> Dict[str, Any]:
-        return {"rows": self._yielded + self._skip_rows}
-
-    def restore(self, state: Dict[str, Any]) -> None:
-        self._skip_rows = int(state["rows"])
-        self._yielded = 0
 
 
 def write_memmap(tokens: np.ndarray, path: str, dtype: str = "uint16") -> str:

@@ -11,7 +11,12 @@ standard library:
 - ``read_index`` — index file → shard path list (``#`` comments skipped);
 - ``TarShardSource`` — iterates samples out of (optionally gzipped) tar
   shards; shard order reshuffles each epoch from (seed, epoch) so every
-  process derives the same order with no communication.
+  process derives the same order with no communication. With many shards it
+  stripes at SHARD granularity per process (each host opens only its own
+  shards — the reference's per-process shard split, ``main_zero.py:389-405``)
+  and flags itself ``pre_striped`` so the loader skips row striping; with few
+  shards it falls back to every-process-reads-everything + loader row
+  striping.
 
 Sample decoding: each tar member is one sample; supported payloads are
 ``.npy`` (numpy), ``.json`` (list of ints), ``.bin``/``.u16`` (raw uint16),
@@ -29,11 +34,11 @@ import json
 import re
 import tarfile
 from pathlib import Path
-from typing import Any, Dict, Iterator, List, Sequence
+from typing import Iterator, List, Sequence
 
 import numpy as np
 
-from zero_transformer_tpu.data.sources import TokenSource
+from zero_transformer_tpu.data.sources import ReplayStreamSource
 
 _BRACE = re.compile(r"\{(\d+)\.\.(\d+)\}")
 
@@ -91,7 +96,7 @@ def _decode_member(name: str, data: bytes):
     return None  # unknown payload (e.g. __key__ metadata): skip
 
 
-class TarShardSource(TokenSource):
+class TarShardSource(ReplayStreamSource):
     """Stream token rows out of tar shards, webdataset-style.
 
     Args:
@@ -99,10 +104,15 @@ class TarShardSource(TokenSource):
       max_context: row length (shorter samples skipped, longer truncated).
       seed: shard-order shuffle seed; order reshuffles each epoch.
       shuffle_shards: False keeps index order (validation).
+      process_index/process_count: multi-host placement for shard striping.
+      stripe_shards: "auto" stripes at shard granularity when every process
+        can own >= 2 shards (per-host IO then scales 1/P instead of every
+        host decompressing every shard); True forces it, False disables.
 
-    Resume: ``seek``/``restore`` replay the stream and discard — the
-    reference's islice fast-forward (``main_zero.py:470-471``); O(rows) but
-    exact for any shard contents.
+    Resume: ``seek``/``restore`` replay the stream and discard
+    (``ReplayStreamSource``) — the reference's islice fast-forward
+    (``main_zero.py:470-471``); O(rows) but exact for any shard contents.
+    Positions are counted in the rows THIS process yields, striped or not.
     """
 
     def __init__(
@@ -111,6 +121,9 @@ class TarShardSource(TokenSource):
         max_context: int,
         seed: int = 23,
         shuffle_shards: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
+        stripe_shards: bool | str = "auto",
     ):
         if isinstance(shards, (str, Path)):
             shards = [str(shards)]
@@ -127,14 +140,32 @@ class TarShardSource(TokenSource):
         self.max_context = max_context
         self.seed = seed
         self.shuffle_shards = shuffle_shards
-        self._skip_rows = 0
-        self._yielded = 0
+        self.process_index = process_index
+        self.process_count = process_count
+        if stripe_shards == "auto":
+            stripe_shards = len(expanded) >= 2 * process_count
+        elif stripe_shards and len(expanded) < process_count:
+            raise ValueError(
+                f"stripe_shards=True with {len(expanded)} shards < "
+                f"{process_count} processes: some processes would own no "
+                "shards and yield nothing"
+            )
+        # pre_striped tells the DataLoader this source already yields only
+        # this process's rows, so its row striping must be skipped.
+        self.pre_striped = bool(stripe_shards) and process_count > 1
+        super().__init__()
 
     def _shard_order(self, epoch: int) -> List[str]:
-        if not self.shuffle_shards:
-            return list(self.shards)
-        rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
-        return [self.shards[i] for i in rng.permutation(len(self.shards))]
+        if self.shuffle_shards:
+            rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+            order = [self.shards[i] for i in rng.permutation(len(self.shards))]
+        else:
+            order = list(self.shards)
+        if self.pre_striped:
+            # every process computes the same global order, then takes its
+            # disjoint slice — reshuffled each epoch so ownership rotates
+            order = order[self.process_index :: self.process_count]
+        return order
 
     def _samples(self) -> Iterator[np.ndarray]:
         epoch = 0
@@ -155,22 +186,3 @@ class TarShardSource(TokenSource):
                             continue
                         yield ids[: self.max_context].astype(np.int32)
             epoch += 1
-
-    def __iter__(self) -> Iterator[np.ndarray]:
-        skipped = 0
-        for row in self._samples():
-            if skipped < self._skip_rows:
-                skipped += 1
-                continue
-            self._yielded += 1
-            yield row
-
-    def seek(self, n_rows: int) -> None:
-        self._skip_rows += n_rows
-
-    def state(self) -> Dict[str, Any]:
-        return {"rows": self._yielded + self._skip_rows}
-
-    def restore(self, state: Dict[str, Any]) -> None:
-        self._skip_rows = int(state["rows"])
-        self._yielded = 0

@@ -53,29 +53,43 @@ def perplexity(
     batch_size: int = 8,
     num_bytes: Optional[int] = None,
 ) -> dict:
-    """Token-stream perplexity in non-overlapping [seq_len] windows.
+    """Token-stream perplexity over [seq_len] windows with one token of
+    overlap (stride ``seq_len - 1``), so every token except the stream's very
+    first is predicted exactly once — the rolling-loglikelihood convention
+    lm-eval-harness uses, whose numbers the reference publishes.
 
     With ``num_bytes`` (the UTF-8 length of the source text) also reports
     bits-per-byte: nll_total / (ln2 * bytes) — the Pile metric the reference
-    reports (reference ``logs/1B.md:25-29``, ``logs/760.md:66-70``).
+    reports (reference ``logs/1B.md:25-29``, ``logs/760.md:66-70``). Only the
+    first token of the whole stream is unscored (it has no context), matching
+    the harness convention.
     """
     tokens = np.asarray(tokens, np.int32)
-    n_windows = len(tokens) // seq_len
-    if n_windows == 0:
-        raise ValueError(f"need at least {seq_len} tokens, got {len(tokens)}")
-    windows = tokens[: n_windows * seq_len].reshape(n_windows, seq_len)
+    if len(tokens) < 2:
+        raise ValueError(f"need at least 2 tokens, got {len(tokens)}")
+    stride = seq_len - 1
+    n_windows = (len(tokens) - 2) // stride + 1
+    # pad the tail once so every window is a strided view; the pad is masked
+    padded = np.zeros(n_windows * stride + 1, np.int32)
+    padded[: len(tokens)] = tokens
+    windows = np.lib.stride_tricks.sliding_window_view(padded, seq_len)[::stride]
+    # all windows share the mask pattern [0,1,1,...] except the last, where
+    # positions past the real tail are off
+    window_masks = np.zeros((n_windows, seq_len), np.int32)
+    window_masks[:, 1:] = 1
+    tail = len(tokens) - (n_windows - 1) * stride  # real length of last window
+    window_masks[-1, tail:] = 0
 
     total_nll, total_tok = 0.0, 0
     for start in range(0, n_windows, batch_size):
         chunk = windows[start : start + batch_size]
-        pad_n = batch_size - len(chunk)
+        mask = window_masks[start : start + batch_size]
+        n_real = len(chunk)
+        pad_n = batch_size - n_real
         if pad_n:
             chunk = np.concatenate([chunk, np.zeros((pad_n, seq_len), np.int32)])
-        mask = np.ones_like(chunk)
-        mask[len(windows[start : start + batch_size]) :] = 0
-        # every position after the first is a prediction target
+            mask = np.concatenate([mask, np.zeros((pad_n, seq_len), np.int32)])
         res = score_batch(model, params, jnp.asarray(chunk), jnp.asarray(mask))
-        n_real = len(windows[start : start + batch_size])
         total_nll += -float(jnp.sum(res["logprob"][:n_real]))
         total_tok += int(jnp.sum(res["tokens"][:n_real]))
 

@@ -32,15 +32,15 @@ class SamplingConfig:
 
     temperature: float = 1.0
     top_k: int = 0  # 0 = disabled
-    top_p: float = 0.0  # 0 = disabled
+    top_p: float = 0.0  # 0 or 1 = disabled
     repetition_penalty: float = 1.0  # 1 = disabled
     greedy: bool = False
 
     def __post_init__(self):
         if self.temperature <= 0:
             raise ValueError("temperature must be > 0")
-        if self.top_p < 0 or self.top_p >= 1.0 and self.top_p != 0.0:
-            raise ValueError("top_p must be in [0, 1)")
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1]")
         if self.top_k < 0:
             raise ValueError("top_k must be >= 0")
 
@@ -64,8 +64,10 @@ def top_k_filter(logits: jax.Array, k: int) -> jax.Array:
 
 
 def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
-    """Nucleus filtering: keep the smallest prefix with cumulative prob > p."""
-    if p <= 0.0:
+    """Nucleus filtering: keep the smallest prefix with cumulative prob > p.
+
+    p == 1.0 is the conventional "disabled" value (keeps everything)."""
+    if p <= 0.0 or p >= 1.0:
         return logits
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)

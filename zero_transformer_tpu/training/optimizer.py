@@ -10,9 +10,11 @@ are [n_layers, d].
 """
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable, Optional
 
 import flax.traverse_util as traverse_util
+import jax
+import jax.numpy as jnp
 import optax
 
 from zero_transformer_tpu.config import OptimizerConfig
@@ -60,10 +62,44 @@ def weight_decay_mask(params: Any) -> Any:
     )
 
 
-def make_optimizer(cfg: OptimizerConfig, schedule=None) -> optax.GradientTransformation:
+def _clip_by_norm_fn(max_norm: float, norm_fn: Callable) -> optax.GradientTransformation:
+    """``optax.clip_by_global_norm`` with a pluggable norm — needed inside a
+    shard_map region, where ``optax.global_norm`` would see only this device's
+    gradient SHARDS (the true norm needs a psum across the ZeRO axis). Same
+    ``EmptyState`` as optax's clip, so the optimizer-state pytree structure —
+    and therefore checkpoints — are identical between the GSPMD and
+    explicit-collective train steps."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        norm = norm_fn(updates)
+        # optax semantics: scale by max_norm/norm only when norm exceeds it
+        scale = jnp.minimum(1.0, max_norm / (norm + 1e-16))
+        return jax.tree.map(lambda u: u * scale, updates), state
+
+    return optax.GradientTransformation(init, update)
+
+
+def make_optimizer(
+    cfg: OptimizerConfig,
+    schedule=None,
+    global_norm_fn: Optional[Callable] = None,
+) -> optax.GradientTransformation:
+    """AdamW chain. ``global_norm_fn`` swaps the grad-clip norm computation
+    (used by the explicit-collective ZeRO step, which runs the update on
+    gradient shards); state structure is unchanged either way."""
     schedule = schedule or make_schedule(cfg)
+    clip = (
+        _clip_by_norm_fn(cfg.grad_clip, global_norm_fn)
+        if global_norm_fn is not None
+        else optax.clip_by_global_norm(cfg.grad_clip)
+    )
     return optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip),
+        clip,
         optax.adamw(
             learning_rate=schedule,
             b1=cfg.b1,

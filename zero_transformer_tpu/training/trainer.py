@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import signal
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -64,7 +66,15 @@ class Trainer:
             self.model, self.tx, self.mesh, self.sample_shape, self.zero_stage
         )
         self.train_step = make_train_step(
-            self.model, self.tx, self.mesh, self.plan, self.zero_stage, self.schedule
+            self.model,
+            self.tx,
+            self.mesh,
+            self.plan,
+            self.zero_stage,
+            self.schedule,
+            # lets the explicit ZeRO-2/3 core rebuild the optimizer with a
+            # shard-aware grad-clip norm (same opt-state structure)
+            tx_factory=lambda norm_fn: make_optimizer(opt, self.schedule, norm_fn),
         )
         self.eval_step = make_eval_step(self.model, self.mesh, self.plan)
         self.batch_sharding = NamedSharding(
@@ -81,8 +91,13 @@ class Trainer:
             save_frequency=cfg.checkpoint.save_frequency,
             async_save=cfg.checkpoint.async_save,
         )
+        from zero_transformer_tpu.config import flatten_config
+
         self.metrics = monitoring.MetricsLogger(
-            directory=cfg.checkpoint.directory, use_wandb=use_wandb
+            directory=cfg.checkpoint.directory,
+            use_wandb=use_wandb,
+            # full flattened run config at init (reference main_zero.py:354-366)
+            config=flatten_config(cfg),
         )
         self.rng = jax.random.PRNGKey(cfg.training.seed)
         self.flops_per_token = monitoring.model_flops_per_token(
@@ -194,6 +209,23 @@ class Trainer:
         loss = total / max(n, 1)
         return {"loss": loss, "perplexity": float(jnp.exp(jnp.minimum(loss, 20.0)))}
 
+    def _install_preemption_handler(self):
+        """SIGTERM → finish the current step, force-save, exit the train loop
+        cleanly (preemption handling the reference lacks — its only recovery
+        was rerunning with --resume, reference ``main_zero.py:48-52``).
+        Returns (flag, restore_fn); no-op off the main thread."""
+        flag = threading.Event()
+        if threading.current_thread() is not threading.main_thread():
+            return flag, lambda: None
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            log.warning("SIGTERM: will checkpoint and stop after this step")
+            flag.set()
+
+        signal.signal(signal.SIGTERM, handler)
+        return flag, lambda: signal.signal(signal.SIGTERM, previous)
+
     def train(self, max_steps: Optional[int] = None) -> TrainState:
         cfg = self.cfg.training
         state = self.state if self.state is not None else self.init_state()
@@ -205,14 +237,27 @@ class Trainer:
         tokens_per_step = cfg.batch_size * cfg.train_context * max(
             cfg.gradient_accumulation_steps, 1
         )
+        preempted, restore_handler = self._install_preemption_handler()
+        profile_dir = cfg.profile_dir or f"{self.cfg.checkpoint.directory}/profile"
+        # trace window [start+1, start+1+profile_steps): skips the compile step
+        profile_stop = start + 1 + cfg.profile_steps if cfg.profile_steps else None
+        profiling = False
 
         step = start
         tick_step = start  # step at which the timing window last restarted
         while step < end:
+            if profile_stop and not profiling and step == start + 1:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+                log.info("profiler: tracing %d steps to %s", cfg.profile_steps, profile_dir)
             local = next(it)
             batch = device_put_batch(local, self.batch_sharding)
             state, metrics = self.train_step(state, batch, self.rng)
             step += 1
+            if profiling and step >= profile_stop:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
 
             if step % cfg.log_frequency == 0 or step == end:
                 loss = float(metrics["loss"])  # device sync point
@@ -248,6 +293,13 @@ class Trainer:
                 timer.tick()
                 tick_step = step
 
+            if preempted.is_set():
+                log.warning("preemption: saving at step %d and stopping", step)
+                break
+
+        if profiling:
+            jax.profiler.stop_trace()
+        restore_handler()
         if self.ckpt.latest_step() != step:
             self.ckpt.save(
                 step, state, meta={"loader": self.train_loader.state()}, force=True

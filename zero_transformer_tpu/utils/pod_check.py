@@ -17,8 +17,10 @@ Usage: ``python -m zero_transformer_tpu.utils.pod_check [--timeout 60]``.
 from __future__ import annotations
 
 import argparse
-import concurrent.futures
 import functools
+import os
+import sys
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -48,24 +50,35 @@ def _allreduce_count(devices) -> float:
 def pod_check(timeout: float = 60.0, verbose: bool = True) -> bool:
     """Run global + local collective checks. Returns True when healthy."""
 
-    def run() -> tuple[float, float]:
-        global_count = _allreduce_count(jax.devices())
-        local_count = _allreduce_count(jax.local_devices())
-        return global_count, local_count
+    result: dict = {}
 
-    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-        fut = pool.submit(run)
+    def run() -> None:
         try:
-            global_count, local_count = fut.result(timeout=timeout)
-        except concurrent.futures.TimeoutError:
-            if verbose:
-                print(
-                    f"UNHEALTHY: collective did not complete within {timeout:.0f}s "
-                    "— a host or device is hung (the reference's documented "
-                    "remedy: kill stray processes on every host and restart, "
-                    "pod_test.py:1-6)"
-                )
-            return False
+            result["global"] = _allreduce_count(jax.devices())
+            result["local"] = _allreduce_count(jax.local_devices())
+        except Exception as e:  # reported distinctly from a timeout below
+            result["error"] = e
+
+    # A hung collective cannot be cancelled from Python: the worker must be a
+    # daemon thread so it never blocks process exit (a ThreadPoolExecutor's
+    # __exit__ would join it forever — the exact hang this check diagnoses).
+    worker = threading.Thread(target=run, daemon=True)
+    worker.start()
+    worker.join(timeout)
+    if "error" in result:
+        if verbose:
+            print(f"UNHEALTHY: collective raised: {result['error']!r}")
+        return False
+    if worker.is_alive() or "local" not in result:
+        if verbose:
+            print(
+                f"UNHEALTHY: collective did not complete within {timeout:.0f}s "
+                "— a host or device is hung (the reference's documented "
+                "remedy: kill stray processes on every host and restart, "
+                "pod_test.py:1-6)"
+            )
+        return False
+    global_count, local_count = result["global"], result["local"]
 
     ok = global_count == jax.device_count() and local_count == jax.local_device_count()
     if verbose:
@@ -82,7 +95,15 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="zero_transformer_tpu.utils.pod_check")
     p.add_argument("--timeout", type=float, default=60.0)
     args = p.parse_args(argv)
-    raise SystemExit(0 if pod_check(args.timeout) else 1)
+    healthy = pod_check(args.timeout)
+    if not healthy:
+        # The daemon worker may still hold the hung collective; a normal exit
+        # would wait on runtime teardown. Flush and hard-exit with the
+        # diagnosis already printed.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
+    raise SystemExit(0)
 
 
 if __name__ == "__main__":
