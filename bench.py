@@ -14,13 +14,20 @@ so a hung TPU backend init (observed in this image: ``jax.devices()`` can
 block >300s) is killed and recorded instead of taking the whole capture down
 (round-1 failure mode: rc=1, no JSON). Scenario ladder:
 
-  1. TPU, 580M, remat off   (best MFU when it fits)
-  2. TPU, 580M, remat on    (the memory-safe configuration)
-  3. TPU flash-attention microbenchmark (extra; only after a TPU success)
+  1. TPU, 580M, remat on    (the memory-safe configuration — runs FIRST so a
+     good number always lands before risky upside experiments; round-2 ran
+     the OOM-prone remat-off config first and lost the artifact)
+  2. TPU, 580M, remat off   (upside experiment; smaller per-step batch so it
+     has a chance of fitting 16 GB v5e HBM, same 64k tokens/step via accum)
+  3. TPU flash-attention microbenchmark sweep T in {1k,4k,8k,16k}
+     (extra; only after a TPU success)
   4. CPU smoke fallback     (only if every TPU scenario failed)
 
-The parent always exits 0 with exactly one JSON line; errors ride in
-``extra.errors``.
+The parent always exits 0 with exactly ONE parseable JSON line; errors ride
+in ``extra.errors``. Every string embedded in the output is truncated to
+<=2 KB (round-2 failure mode: a multi-hundred-KB XLA OOM dump stringified
+into the line made it unparseable to the driver's tail capture), and the
+final line is verified with ``json.loads`` and size-capped before printing.
 """
 from __future__ import annotations
 
@@ -30,6 +37,30 @@ import subprocess
 import sys
 
 BASELINE_TOK_S_CHIP = 4300.0  # reference 580M on TPU v3 (BASELINE.md, derived)
+
+MAX_ERR_CHARS = 2048  # hard cap on any string embedded in the output JSON
+MAX_LINE_CHARS = 24_000  # hard cap on the final JSON line itself
+
+
+def _truncate(s: str, limit: int = MAX_ERR_CHARS) -> str:
+    """Keep the head and tail of an oversized string (XLA dumps bury the
+    actual error at both ends: the message up top, the allocation table at
+    the bottom)."""
+    if len(s) <= limit:
+        return s
+    head, tail = limit * 2 // 3, limit // 3
+    return s[:head] + f" ...[{len(s) - head - tail} chars truncated]... " + s[-tail:]
+
+
+def _sanitize(obj):
+    """Recursively truncate every string in a JSON-able structure."""
+    if isinstance(obj, str):
+        return _truncate(obj)
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
 
 
 # ----------------------------------------------------------------- children
@@ -139,7 +170,10 @@ def child_train() -> dict:
 
 
 def child_flash() -> dict:
-    """Flash-vs-XLA attention microbenchmark at 580M shapes (TPU only)."""
+    """Flash-vs-XLA attention microbenchmark, fwd+bwd, swept over sequence
+    lengths (the kernel exists to make 8k-32k context viable — one 1k
+    datapoint says nothing about that regime). Batch shrinks as T grows to
+    hold tokens (B*T) constant, the way a real long-context run would."""
     import time
 
     import jax
@@ -151,13 +185,11 @@ def child_flash() -> dict:
     from zero_transformer_tpu.ops.pallas.flash import flash_attention
 
     print(f"devices_ok platform={jax.default_backend()}", file=sys.stderr)
-    B, T, H, D = 8, int(os.environ.get("BENCH_SEQ", "1024")), 12, 128
-    q, k, v = (
-        jax.random.normal(jax.random.PRNGKey(i), (B, T, H, D), jnp.bfloat16)
-        for i in range(3)
-    )
+    seqs = [int(s) for s in os.environ.get("BENCH_FLASH_SEQS", "1024,4096,8192,16384").split(",")]
+    H, D = 12, 128
+    tokens = 8 * 1024  # B*T held constant across the sweep
 
-    def bench(fn, reps=20):
+    def bench(fn, q, k, v, reps=10):
         lossf = lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32))
         step = jax.jit(jax.grad(lossf, argnums=(0, 1, 2)))
         out = step(q, k, v)  # compile
@@ -168,18 +200,39 @@ def child_flash() -> dict:
         float(jnp.sum(out[0].astype(jnp.float32)))
         return (time.perf_counter() - t0) / reps * 1e3  # ms
 
-    xla_ms = bench(lambda q, k, v: xla_attention(q, k, v, causal=True, alibi=True))
-    flash_ms = bench(lambda q, k, v: flash_attention(q, k, v, causal=True, alibi=True))
-    # fwd+bwd attention FLOPs: ~4*B*T^2*H*D fwd, x2.5 with bwd, causal halves
-    flops = 4 * B * T * T * H * D * 2.5 / 2
-    return {
-        "ok": True,
-        "shape": [B, T, H, D],
-        "xla_ms": round(xla_ms, 3),
-        "flash_ms": round(flash_ms, 3),
-        "speedup": round(xla_ms / flash_ms, 2),
-        "flash_tflops": round(flops / (flash_ms * 1e-3) / 1e12, 1),
-    }
+    points = []
+    for T in seqs:
+        B = max(1, tokens // T)
+        try:
+            q, k, v = (
+                jax.random.normal(jax.random.PRNGKey(i), (B, T, H, D), jnp.bfloat16)
+                for i in range(3)
+            )
+            flash_ms = bench(
+                lambda q, k, v: flash_attention(q, k, v, causal=True, alibi=True), q, k, v
+            )
+            # XLA full-matrix attention at 16k materializes B*H*T*T scores;
+            # guard it separately so a flash datapoint still lands if XLA OOMs.
+            try:
+                xla_ms = bench(
+                    lambda q, k, v: xla_attention(q, k, v, causal=True, alibi=True), q, k, v
+                )
+            except Exception as e:
+                xla_ms = None
+            # fwd+bwd attention FLOPs: ~4*B*T^2*H*D fwd, x2.5 with bwd, causal halves
+            flops = 4 * B * T * T * H * D * 2.5 / 2
+            points.append(
+                {
+                    "shape": [B, T, H, D],
+                    "xla_ms": round(xla_ms, 3) if xla_ms else None,
+                    "flash_ms": round(flash_ms, 3),
+                    "speedup": round(xla_ms / flash_ms, 2) if xla_ms else None,
+                    "flash_tflops": round(flops / (flash_ms * 1e-3) / 1e12, 1),
+                }
+            )
+        except Exception as e:
+            points.append({"shape": [B, T, H, D], "error": _truncate(f"{type(e).__name__}: {e}", 512)})
+    return {"ok": any("flash_ms" in p for p in points), "points": points}
 
 
 # ------------------------------------------------------------------- parent
@@ -218,7 +271,10 @@ def _run_child(scenario: str, env_extra: dict, timeout: float) -> dict:
             except json.JSONDecodeError:
                 break
     tail = (proc.stderr or "").strip().splitlines()[-8:]
-    return {"ok": False, "error": f"rc={proc.returncode}: " + " | ".join(tail)}
+    return {
+        "ok": False,
+        "error": _truncate(f"rc={proc.returncode}: " + " | ".join(tail)),
+    }
 
 
 def main() -> None:
@@ -227,8 +283,10 @@ def main() -> None:
         try:
             result = child_flash() if scenario == "flash" else child_train()
         except Exception as e:
-            result = {"ok": False, "error": f"{type(e).__name__}: {e}"}
-        print(json.dumps(result), flush=True)
+            # XLA OOMs stringify to hundreds of KB — truncate HERE, at the
+            # source, so no oversized string ever enters the artifact path.
+            result = {"ok": False, "error": _truncate(f"{type(e).__name__}: {e}")}
+        print(json.dumps(_sanitize(result)), flush=True)
         return
 
     # ---- parent mode: scenario ladder, one final JSON line, always rc=0
@@ -236,14 +294,19 @@ def main() -> None:
     results: dict = {}
     tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
 
+    # remat_on runs FIRST: it is the memory-safe configuration, so a good
+    # number always lands before upside experiments (round-2 lesson). The
+    # remat_off upside run uses half the per-step batch (same 64k tokens/step
+    # via doubled accum) so its activation temporaries have a chance of
+    # fitting 16 GB v5e HBM.
     for name, env_extra in (
-        ("remat_off", {"BENCH_REMAT": "0"}),
         ("remat_on", {"BENCH_REMAT": "1"}),
+        ("remat_off", {"BENCH_REMAT": "0", "BENCH_BATCH": "4", "BENCH_ACCUM": "16"}),
     ):
         res = _run_child("train", env_extra, tpu_timeout)
         results[name] = res
         if not res.get("ok"):
-            errors.append(f"{name}: {res.get('error')}")
+            errors.append(_truncate(f"{name}: {res.get('error')}"))
             if res.get("backend_init_hung"):
                 errors.append("skipping further TPU scenarios: backend init hung")
                 break
@@ -256,9 +319,9 @@ def main() -> None:
 
     if tpu_good:
         best = max(tpu_good, key=lambda r: r["tok_s_chip"])
-        flash = _run_child("flash", {}, 300.0)
+        flash = _run_child("flash", {}, 600.0)
         if not flash.get("ok"):
-            errors.append(f"flash: {flash.get('error')}")
+            errors.append(_truncate(f"flash: {flash.get('error')}"))
         out = {
             "metric": f"train_tokens_per_sec_per_chip_{best['model']}",
             "value": best["tok_s_chip"],
@@ -283,7 +346,7 @@ def main() -> None:
             300.0,
         )
         if not res.get("ok"):
-            errors.append(f"cpu: {res.get('error')}")
+            errors.append(_truncate(f"cpu: {res.get('error')}"))
         out = {
             "metric": "train_tokens_per_sec_per_chip_cpu_fallback",
             "value": res.get("tok_s_chip", 0.0),
@@ -291,7 +354,15 @@ def main() -> None:
             "vs_baseline": 0.0,  # no TPU datapoint: honest zero, see errors
             "extra": {"scenarios": results, "cpu_fallback": res, "errors": errors},
         }
-    print(json.dumps(out), flush=True)
+
+    # Artifact contract: exactly one JSON line, parseable, bounded size.
+    line = json.dumps(_sanitize(out))
+    if len(line) > MAX_LINE_CHARS:  # drop detail until it fits
+        out["extra"] = {"errors": [_truncate(e, 512) for e in errors[:8]],
+                        "detail_dropped": "output exceeded size cap"}
+        line = json.dumps(_sanitize(out))
+    json.loads(line)  # hard assert: never print an unparseable artifact
+    print(line, flush=True)
 
 
 if __name__ == "__main__":

@@ -142,6 +142,58 @@ class TestDataLoader:
             DataLoader(SyntheticSource(100, 64, seed=0), batch_size=3,
                        train_context=32, process_index=0, process_count=2)
 
+    def test_prefetch_matches_sync(self):
+        # identical stream with and without the background producer thread
+        def batches(prefetch, n=6):
+            dl = DataLoader(SyntheticSource(100, 32, seed=0), batch_size=4,
+                            train_context=32, process_index=0, process_count=1,
+                            prefetch=prefetch)
+            return take(iter(dl), n)
+
+        for a, b in zip(batches(0), batches(3)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_prefetch_counts_only_yielded_steps(self):
+        # steps_consumed must reflect batches YIELDED, not read ahead —
+        # otherwise checkpoint resume state would drift by the queue depth
+        dl = DataLoader(SyntheticSource(100, 32, seed=0), batch_size=4,
+                        train_context=32, process_index=0, process_count=1,
+                        prefetch=4)
+        it = iter(dl)
+        take(it, 3)
+        assert dl.steps_consumed == 3
+
+    def test_prefetch_reiteration_loses_no_batches(self):
+        # abandoning a prefetching iterator mid-stream (the trainer's chunked
+        # train(max_steps=k) pattern) must not skip the read-ahead batches:
+        # a fresh iterator serves them before new source reads
+        sync = DataLoader(SyntheticSource(100, 32, seed=0), batch_size=4,
+                          train_context=32, process_index=0, process_count=1)
+        want = take(iter(sync), 8)
+
+        dl = DataLoader(SyntheticSource(100, 32, seed=0), batch_size=4,
+                        train_context=32, process_index=0, process_count=1,
+                        prefetch=3)
+        got = take(iter(dl), 3)          # first iterator reads ahead ~3 more
+        got += take(iter(dl), 5)         # second iterator must continue exactly
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+        assert dl.steps_consumed == 8
+
+    def test_prefetch_propagates_source_error(self):
+        class BoomSource(SyntheticSource):
+            def __iter__(self):
+                yield self._row(0)
+                raise RuntimeError("decode failed")
+
+        dl = DataLoader(BoomSource(100, 32, seed=0), batch_size=1,
+                        train_context=32, process_index=0, process_count=1,
+                        prefetch=2)
+        it = iter(dl)
+        next(it)
+        with pytest.raises(RuntimeError, match="decode failed"):
+            next(it)
+
     def test_device_put_batch_sharded(self, devices):
         mesh = make_mesh(devices=devices)
         sharding = NamedSharding(mesh, P(None, "data", None))

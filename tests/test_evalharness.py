@@ -12,7 +12,13 @@ import numpy as np
 import pytest
 
 from zero_transformer_tpu.config import ModelConfig
-from zero_transformer_tpu.evalharness import lambada, loglikelihoods, perplexity, score_batch
+from zero_transformer_tpu.evalharness import (
+    choice_accuracy,
+    lambada,
+    loglikelihoods,
+    perplexity,
+    score_batch,
+)
 from zero_transformer_tpu.models import Transformer
 
 CFG = ModelConfig(
@@ -93,6 +99,72 @@ def test_lambada_metrics(model_and_params):
     res = loglikelihoods(model, params, examples, seq_len=16, batch_size=2)
     lp = sum(r["logprob"] for r in res) / sum(r["tokens"] for r in res)
     np.testing.assert_allclose(out["ppl"], math.exp(-lp), rtol=1e-6)
+
+
+def test_choice_accuracy_matches_manual_argmax(model_and_params):
+    """acc/acc_norm must equal a hand computation from raw loglikelihoods."""
+    model, params = model_and_params
+    rng = np.random.default_rng(7)
+    examples = []
+    for _ in range(6):
+        ctx = list(rng.integers(1, 60, 5))
+        choices = [list(rng.integers(1, 60, n)) for n in (2, 4, 3)]
+        byte_lens = [9, 21, 15]  # surface-string UTF-8 lengths
+        examples.append((ctx, choices, int(rng.integers(0, 3)), byte_lens))
+
+    out = choice_accuracy(model, params, examples, seq_len=16, batch_size=4)
+    assert out["norm"] == "bytes" and out["examples"] == 6
+
+    # manual recomputation via the scoring primitive
+    acc_hits, norm_hits = 0, 0
+    for ctx, choices, gold, byte_lens in examples:
+        lps = [
+            loglikelihoods(model, params, [(ctx, c)], seq_len=16, batch_size=1)[0][
+                "logprob"
+            ]
+            for c in choices
+        ]
+        acc_hits += int(np.argmax(lps)) == gold
+        norm_hits += int(np.argmax([l / b for l, b in zip(lps, byte_lens)])) == gold
+    np.testing.assert_allclose(out["acc"], acc_hits / 6)
+    np.testing.assert_allclose(out["acc_norm"], norm_hits / 6)
+
+
+def test_choice_accuracy_token_norm_fallback(model_and_params):
+    model, params = model_and_params
+    examples = [([5, 9, 2], [[1, 2], [3], [4, 5, 6]], 1)]  # no byte lengths
+    out = choice_accuracy(model, params, examples, seq_len=16, batch_size=2)
+    assert out["norm"] == "tokens"
+    assert 0.0 <= out["acc"] <= 1.0 and 0.0 <= out["acc_norm"] <= 1.0
+
+
+def test_choice_accuracy_rejects_mixed_normalization(model_and_params):
+    model, params = model_and_params
+    examples = [
+        ([5, 9], [[1], [2]], 0, [4, 7]),
+        ([5, 9], [[1], [2]], 1),  # missing byte lengths
+    ]
+    with pytest.raises(ValueError, match="all examples or none"):
+        choice_accuracy(model, params, examples, seq_len=8, batch_size=2)
+
+
+def test_choice_accuracy_micro_golden(model_and_params):
+    """A rigged two-choice example where raw and normalized argmax MUST
+    disagree: choice A = one copy of a high-probability token, choice B = two
+    copies of it. B's summed logprob is lower (more tokens) but its per-byte
+    score can win with a long byte length assigned to A. Pin both criteria."""
+    model, params = model_and_params
+    ctx = [5, 9]
+    lp = loglikelihoods(
+        model, params, [(ctx, [11]), (ctx, [11, 11])], seq_len=8, batch_size=2
+    )
+    lp_a, lp_b = lp[0]["logprob"], lp[1]["logprob"]
+    assert lp_a > lp_b  # one factor vs two: strictly more probable
+    # bytes: A long (normalizes to tiny), B short (normalizes to big)
+    examples = [(ctx, [[11], [11, 11]], 0, [100, 1])]
+    out = choice_accuracy(model, params, examples, seq_len=8, batch_size=2)
+    assert out["acc"] == 1.0  # raw picks A (gold)
+    assert out["acc_norm"] == (1.0 if lp_a / 100 > lp_b / 1 else 0.0)
 
 
 def test_perplexity_and_bpb(model_and_params):

@@ -53,7 +53,11 @@ def main():
         metavar="N",
         help="capture a jax.profiler trace of N steps (after the compile step)",
     )
-    parser.add_argument("--set", nargs="*", default=None, metavar="KEY=VALUE")
+    # action="extend": repeated --set flags accumulate instead of the last
+    # occurrence silently replacing earlier ones
+    parser.add_argument(
+        "--set", nargs="*", action="extend", default=None, metavar="KEY=VALUE"
+    )
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)

@@ -168,7 +168,10 @@ class DataConfig:
     max_context: int = 2048
     shuffle_buffer: int = 10_000
     shuffle_seed: int = 23
-    num_workers: int = 0
+    # batches decoded ahead of the train step by a background thread
+    # (DataLoader.prefetch); 0 = fully synchronous. The reference used torch
+    # DataLoader workers for the same overlap (main_zero.py:407-421).
+    num_workers: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

@@ -75,4 +75,8 @@ def make_loader(
             cfg.data.shuffle_buffer if cfg.data.source == "hf" else 0
         ),
         seed=cfg.data.shuffle_seed,
+        # validation stays synchronous: Trainer.evaluate pins the source to a
+        # fixed window via state()/restore(), which a read-ahead thread would
+        # race; eval is rare and short so overlap buys nothing there
+        prefetch=0 if validation else cfg.data.num_workers,
     )

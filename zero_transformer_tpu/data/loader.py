@@ -17,6 +17,9 @@ Semantics kept from the reference:
 """
 from __future__ import annotations
 
+import collections
+import queue
+import threading
 from typing import Iterator, Optional
 
 import jax
@@ -37,6 +40,13 @@ class DataLoader:
       shuffle_buffer: streaming shuffle-buffer size (0 = off; MemmapSource
         already permutes rows per epoch, so 0 is right for it).
       seed: shuffle-buffer rng seed.
+      prefetch: batches decoded ahead by a background thread (0 = fully
+        synchronous). With prefetch > 0, ``next(it)`` overlaps host decode
+        (gzip/tar/memmap reads release the GIL) with device compute — the
+        role the reference's torch ``DataLoader`` workers played (reference
+        ``main_zero.py:407-421``). ``steps_consumed`` counts batches
+        *yielded*, never batches merely read ahead, so resume state stays
+        exact.
     """
 
     def __init__(
@@ -49,6 +59,7 @@ class DataLoader:
         process_count: Optional[int] = None,
         shuffle_buffer: int = 0,
         seed: int = 23,
+        prefetch: int = 0,
     ):
         self.source = source
         self.batch_size = batch_size
@@ -62,7 +73,12 @@ class DataLoader:
         )
         self.shuffle_buffer = shuffle_buffer
         self.seed = seed
+        self.prefetch = prefetch
         self.steps_consumed = 0
+        # batches a torn-down prefetching iterator had read ahead but never
+        # yielded; the next iterator serves them first so the stream is
+        # identical to the synchronous path even across re-iteration
+        self._leftover: collections.deque = collections.deque()
         # A source that stripes itself (e.g. TarShardSource shard striping)
         # already yields only this process's rows.
         self.pre_striped = bool(getattr(source, "pre_striped", False))
@@ -115,14 +131,86 @@ class DataLoader:
         rng.shuffle(buf)
         yield from buf
 
-    def __iter__(self) -> Iterator[np.ndarray]:
+    def _batches(self) -> Iterator[np.ndarray]:
+        """Synchronous batch assembly (no bookkeeping — ``__iter__`` owns it)."""
         rows = self._shuffled_rows()
         n = self.rows_per_step
         while True:
             block = np.stack([next(rows) for _ in range(n)])  # [n, max_context]
-            batch = block.reshape(
+            yield block.reshape(
                 self.accum_steps, self.local_batch, self.train_context
             )
+
+    def _prefetched(self) -> Iterator[np.ndarray]:
+        """Bounded-queue producer thread running ``_batches`` ahead of the
+        consumer. Exceptions (including source exhaustion) are re-raised at
+        the consuming ``next`` so error behavior matches the sync path.
+
+        Teardown contract: abandoning this iterator must not lose stream
+        position — read-ahead the consumer never saw is parked in
+        ``self._leftover`` for the next iterator (the producer advanced the
+        source past those batches)."""
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        held: list = []  # batch produced but never queued before teardown
+        DONE, ERROR = object(), object()
+
+        def put_polling(item) -> bool:
+            """Blocking put that still honors ``stop`` (a plain q.put could
+            block forever once the consumer is gone and the queue full)."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for batch in self._batches():
+                    if not put_polling(batch):
+                        held.append(batch)
+                        return
+                put_polling(DONE)
+            except BaseException as e:  # forward to consumer
+                put_polling((ERROR, e))
+
+        thread = threading.Thread(
+            target=producer, daemon=True, name="zt-data-prefetch"
+        )
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    return
+                if isinstance(item, tuple) and item and item[0] is ERROR:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            thread.join()
+            while True:  # park unseen read-ahead for the next iterator
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is DONE or (
+                    isinstance(item, tuple) and item and item[0] is ERROR
+                ):
+                    continue
+                self._leftover.append(item)
+            self._leftover.extend(held)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        # read-ahead parked by a previous (abandoned) prefetching iterator
+        # comes first: those batches precede the source's current position
+        while self._leftover:
+            self.steps_consumed += 1
+            yield self._leftover.popleft()
+        batches = self._prefetched() if self.prefetch > 0 else self._batches()
+        for batch in batches:
             self.steps_consumed += 1
             yield batch
 
@@ -130,7 +218,12 @@ class DataLoader:
         """Fast-forward past ``n_steps`` batches (resume). Seeks the source in
         GLOBAL rows so striping stays aligned across processes; a pre-striped
         source counts positions in its own (local) rows instead."""
-        n = n_steps * self.rows_per_step
+        # parked read-ahead is already past the source position: discard it
+        # from the front before seeking the remainder
+        take = min(n_steps, len(self._leftover))
+        for _ in range(take):
+            self._leftover.popleft()
+        n = (n_steps - take) * self.rows_per_step
         self.source.seek(n if self.pre_striped else n * self.process_count)
         self.steps_consumed += n_steps
 

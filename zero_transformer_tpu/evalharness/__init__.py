@@ -1,6 +1,16 @@
 """In-tree TPU eval harness (replaces the reference's export-to-PyTorch +
 GPU lm-eval-harness loop, reference ``torch_compatability/`` + ``README.md:53-57``)."""
 from zero_transformer_tpu.evalharness.scoring import loglikelihoods, score_batch
-from zero_transformer_tpu.evalharness.tasks import lambada, perplexity
+from zero_transformer_tpu.evalharness.tasks import (
+    choice_accuracy,
+    lambada,
+    perplexity,
+)
 
-__all__ = ["lambada", "loglikelihoods", "perplexity", "score_batch"]
+__all__ = [
+    "choice_accuracy",
+    "lambada",
+    "loglikelihoods",
+    "perplexity",
+    "score_batch",
+]

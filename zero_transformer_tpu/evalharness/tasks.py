@@ -1,4 +1,5 @@
-"""Evaluation tasks: LAMBADA-style last-word prediction, document perplexity,
+"""Evaluation tasks: LAMBADA-style last-word prediction, multiple-choice
+accuracy (PIQA / Winogrande / HellaSwag-style), document perplexity,
 bits-per-byte.
 
 Computes on TPU, in-tree, the metrics the reference could only get by
@@ -10,7 +11,7 @@ token sequences — tokenization happens upstream (``serve.py`` /
 from __future__ import annotations
 
 import math
-from typing import Any, Iterable, Optional, Sequence, Tuple
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +43,72 @@ def lambada(
         "ppl": math.exp(-total_lp / max(total_tok, 1)),
         "acc": acc,
         "examples": len(results),
+    }
+
+
+def choice_accuracy(
+    model: Transformer,
+    params: Any,
+    examples: Iterable[tuple],
+    seq_len: int,
+    batch_size: int = 8,
+) -> dict:
+    """Multiple-choice task driver — the scoring convention behind the
+    reference's published PIQA / Winogrande / HellaSwag-norm table
+    (reference ``README.md:53-57``, produced there via lm-eval-harness on an
+    exported PyTorch model; here it runs in-tree on TPU).
+
+    Each example is ``(context_tokens, choices, gold_index)`` or
+    ``(context_tokens, choices, gold_index, choice_byte_lens)`` where
+    ``choices`` is a list of per-choice continuation token lists and
+    ``choice_byte_lens`` the UTF-8 byte length of each choice's surface
+    string. Every choice is scored as sum log P(choice | context); the
+    prediction is the argmax choice under two criteria:
+
+    - ``acc``       — raw summed loglikelihood (PIQA/Winogrande convention);
+    - ``acc_norm``  — loglikelihood / byte length (the "HellaSwag-norm"
+      length normalization). Falls back to token-count normalization when
+      byte lengths aren't provided (reported as ``norm="tokens"``).
+    """
+    examples = list(examples)
+    flat: List[Tuple[Sequence[int], Sequence[int]]] = []
+    spans: List[Tuple[int, int]] = []  # [start, end) into flat per example
+    for ex in examples:
+        ctx, choices = ex[0], ex[1]
+        if not choices:
+            raise ValueError("example has no choices")
+        spans.append((len(flat), len(flat) + len(choices)))
+        flat.extend((ctx, cont) for cont in choices)
+    # one normalization per run: mixing logprob/byte with logprob/token
+    # across examples would make acc_norm a meaningless hybrid
+    has_bytes = [len(ex) > 3 and ex[3] is not None for ex in examples]
+    if any(has_bytes) and not all(has_bytes):
+        raise ValueError(
+            "choice_byte_lens must be provided for all examples or none "
+            f"(got {sum(has_bytes)}/{len(examples)})"
+        )
+    used_bytes = bool(examples) and all(has_bytes)
+    scored = loglikelihoods(model, params, flat, seq_len, batch_size)
+
+    n_correct, n_correct_norm = 0, 0
+    for ex, (start, end) in zip(examples, spans):
+        gold = int(ex[2])
+        lps = [scored[i]["logprob"] for i in range(start, end)]
+        if used_bytes:
+            byte_lens = ex[3]
+        else:
+            byte_lens = [max(scored[i]["tokens"], 1) for i in range(start, end)]
+        if len(byte_lens) != len(lps):
+            raise ValueError("choice_byte_lens length mismatch")
+        n_correct += int(int(np.argmax(lps)) == gold)
+        normed = [lp / max(b, 1) for lp, b in zip(lps, byte_lens)]
+        n_correct_norm += int(int(np.argmax(normed)) == gold)
+    n = max(len(examples), 1)
+    return {
+        "acc": n_correct / n,
+        "acc_norm": n_correct_norm / n,
+        "norm": "bytes" if used_bytes else "tokens",
+        "examples": len(examples),
     }
 
 

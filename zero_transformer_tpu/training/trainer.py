@@ -100,6 +100,10 @@ class Trainer:
             config=flatten_config(cfg),
         )
         self.rng = jax.random.PRNGKey(cfg.training.seed)
+        # validation window pin: source state captured at first evaluate(),
+        # restored before every later one, so eval always scores the SAME
+        # data window and loss curves are comparable step-to-step
+        self._val_window: Optional[dict] = None
         self.flops_per_token = monitoring.model_flops_per_token(
             cfg.model.num_params,
             cfg.model.n_layers,
@@ -199,6 +203,14 @@ class Trainer:
     def evaluate(self, state: Optional[TrainState] = None) -> Dict[str, float]:
         state = state if state is not None else self.state
         max_steps = self.cfg.training.maximum_evaluation_steps
+        # Pin the validation window: without this every evaluate() consumes
+        # the NEXT max_steps batches of a continuing stream, so each eval
+        # scores different data and the loss curve is incomparable across
+        # steps (round-2 verdict, "validation drift").
+        if self._val_window is None:
+            self._val_window = self.val_loader.source.state()
+        else:
+            self.val_loader.source.restore(self._val_window)
         total, n = 0.0, 0
         it = iter(self.val_loader)
         for _ in range(max_steps):
