@@ -92,6 +92,43 @@ def test_model_with_sequence_parallel_matches_single(devices, position):
     np.testing.assert_allclose(float(out), float(ref), rtol=1e-5)
 
 
+def test_ring_with_remat_trains_llama_shapes(devices):
+    """Ring attention composed with per-block rematerialization in a full
+    ZeRO train step, at llama3-family shapes (GQA + RoPE + RMSNorm + SwiGLU,
+    scaled down) on a data=2 x sequence=4 mesh — the configuration an 8k-32k
+    context llama3 run would use (remat for HBM, CP for sequence). Guards
+    that jax.checkpoint's rematerialized backward traverses the ring
+    collectives correctly (loss decreases; grads stay finite)."""
+    from zero_transformer_tpu.parallel import make_plan, init_train_state, make_train_step
+    from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
+    from zero_transformer_tpu.config import OptimizerConfig
+
+    cfg = ModelConfig(
+        name="llama_ring_t", vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+        n_layers=2, max_seq_len=32, dropout=0.0, position="rope", norm="rmsnorm",
+        activation="swiglu", tie_embeddings=False, remat=True,
+        compute_dtype="bfloat16",
+    )
+    opt = OptimizerConfig(peak_learning_rate=3e-3, warmup_steps=2, total_steps=40)
+    mesh = make_mesh(MeshConfig(data=2, sequence=4))
+    model = Transformer(cfg, mesh=mesh)
+    tx = make_optimizer(opt)
+    plan = make_plan(model, tx, mesh, (4, 32), zero_stage=1)
+    state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, (4, 32), plan)
+    step = make_train_step(model, tx, mesh, plan, 1, make_schedule(opt))
+
+    batch = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (1, 4, 32)), jnp.int32
+    )
+    losses = []
+    rng = jax.random.PRNGKey(1)
+    for _ in range(15):
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+        assert np.isfinite(losses[-1]) and np.isfinite(float(metrics["grad_norm"]))
+    assert losses[-1] < losses[0] - 0.5, f"no learning under ring+remat: {losses}"
+
+
 # -- flash-backed ring (Pallas engine, interpret mode) ------------------------
 
 

@@ -1,0 +1,58 @@
+"""wandb login helper (reference ``login.py:20-22`` equivalent)."""
+import os
+
+from zero_transformer_tpu.utils import wandb_login
+
+
+def test_netrc_write_and_replace(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOME", str(tmp_path))
+    path = wandb_login._netrc_login("k1")
+    assert path == str(tmp_path / ".netrc")
+    content = open(path).read()
+    assert "api.wandb.ai" in content and "k1" in content
+    assert oct(os.stat(path).st_mode & 0o777) == "0o600"
+    # relogin replaces the existing entry, never duplicates it
+    wandb_login._netrc_login("k2")
+    content = open(path).read()
+    assert "k2" in content and "k1" not in content
+    assert content.count("api.wandb.ai") == 1
+
+
+def test_key_resolution_order(monkeypatch, tmp_path):
+    class A:
+        key = None
+        key_file = None
+
+    monkeypatch.setenv("WANDB_API_KEY", "envkey")
+    assert wandb_login._resolve_key(A()) == "envkey"
+    monkeypatch.delenv("WANDB_API_KEY")
+    f = tmp_path / "key"
+    f.write_text("filekey\n")
+    A.key_file = str(f)
+    assert wandb_login._resolve_key(A()) == "filekey"
+    A.key = "argkey"
+    assert wandb_login._resolve_key(A()) == "argkey"
+
+
+def test_broadcast_prints_gcloud_with_resolved_key(capsys, monkeypatch):
+    monkeypatch.delenv("WANDB_API_KEY", raising=False)
+    wandb_login.main(
+        ["--broadcast", "mypod", "--zone", "us-central2-b", "--key", "sekrit"]
+    )
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh mypod" in out
+    assert "--worker=all" in out
+    assert "--key sekrit" in out  # works from --key/--key-file, not only env
+
+
+def test_netrc_preserves_following_default_entry(tmp_path, monkeypatch):
+    # a `default` entry after the wandb machine block must survive relogin
+    monkeypatch.setenv("HOME", str(tmp_path))
+    (tmp_path / ".netrc").write_text(
+        "machine api.wandb.ai\n  login user\n  password old\n"
+        "default\n  login u\n  password p\n"
+    )
+    wandb_login._netrc_login("new")
+    content = (tmp_path / ".netrc").read_text()
+    assert "default" in content and "password p" in content
+    assert "old" not in content and "new" in content

@@ -36,12 +36,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zero_transformer_tpu.parallel import sharding as shd
-from zero_transformer_tpu.parallel.mesh import (
-    DATA_AXIS,
-    SEQUENCE_AXIS,
-    TENSOR_AXIS,
-    zero_axes,
-)
+from zero_transformer_tpu.parallel.mesh import SEQUENCE_AXIS, zero_axes
 
 
 @flax.struct.dataclass
@@ -127,22 +122,25 @@ def make_train_step(
     Step signature: ``(state, batch, rng) -> (state, metrics)`` where
     ``batch`` is int32 [accum_steps, global_batch, seq_len] (accum may be 1).
 
-    At stage >= 2 on a pure-DP mesh (tensor = sequence = 1) the step is built
+    At stage >= 2 (any tensor-parallel degree, sequence = 1) the step is built
     around an EXPLICIT shard_map collective core — ``psum_scatter`` gradient
     reduce-scatter, sharded optimizer math, ``all_gather`` of updated params —
     so ZeRO-2/3 semantics are guaranteed by construction (and testable in the
     compiled HLO) rather than hoped for from GSPMD's all-reduce→reduce-scatter
-    rewrite. ``tx_factory(global_norm_fn)`` rebuilds the optimizer with a
-    shard-aware grad-clip norm for that core (see ``make_optimizer``); without
-    it the core pre-clips using the provided ``tx`` (see
-    ``_make_explicit_zero_step``). With TP or CP axes active the GSPMD
-    constraint-hint path below is used instead.
+    rewrite. The core is PARTIAL-MANUAL: only the ZeRO axes (data/fsdp) are
+    manual shard_map axes; the tensor axis stays auto, so GSPMD still
+    partitions the model math (Megatron TP) inside the body while the ZeRO
+    collective schedule is hand-placed. (Verified need: at tensor=2 the
+    constraint-hint path compiles to 0 reduce-scatters and 76 all-reduces —
+    GSPMD legally satisfies the hints with all-reduce + slice.)
+    ``tx_factory(global_norm_fn)`` rebuilds the optimizer with a shard-aware
+    grad-clip norm for that core (see ``make_optimizer``); without it the
+    core pre-clips using the provided ``tx`` (see
+    ``_make_explicit_zero_step``). With the sequence (ring-attention CP) axis
+    active the GSPMD constraint-hint path below is used instead — the ring
+    engine is itself a shard_map and does not nest under a manual ZeRO core.
     """
-    if (
-        zero_stage >= 2
-        and mesh.shape[TENSOR_AXIS] == 1
-        and mesh.shape[SEQUENCE_AXIS] == 1
-    ):
+    if zero_stage >= 2 and mesh.shape[SEQUENCE_AXIS] == 1:
         return _make_explicit_zero_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory
         )
@@ -369,12 +367,30 @@ def _make_explicit_zero_step(
         )
         return new_state, metrics
 
+    zset = set(zaxes)
+
+    def manual_part(spec: P) -> P:
+        """Keep only the ZeRO-axes entries of a spec: the tensor axis stays
+        auto (GSPMD) under the partial-manual shard_map, so specs handed to
+        it may not mention it. Entries name axes as bare strings or tuples
+        (batch specs use ``('data',)``); compare by axis set."""
+
+        def keep(e):
+            if e is None:
+                return None
+            names = set(e) if isinstance(e, tuple) else {e}
+            return e if names <= zset else None
+
+        return P(*(keep(e) for e in spec))
+
     state_specs = TrainState(
         step=P(),
-        params=jax.tree.map(lambda ns: ns.spec, plan.state.params),
-        opt_state=jax.tree.map(lambda ns: ns.spec, plan.state.opt_state),
+        params=jax.tree.map(lambda ns: manual_part(ns.spec), plan.state.params),
+        opt_state=jax.tree.map(
+            lambda ns: manual_part(ns.spec), plan.state.opt_state
+        ),
     )
-    batch_spec = P(None, *plan.batch.spec)
+    batch_spec = manual_part(P(None, *plan.batch.spec))
     metric_specs = {"loss": P(), "grad_norm": P(), "tokens": P()}
     if schedule is not None:
         metric_specs["learning_rate"] = P()
@@ -384,6 +400,7 @@ def _make_explicit_zero_step(
         mesh=mesh,
         in_specs=(state_specs, batch_spec, P()),
         out_specs=(state_specs, metric_specs),
+        axis_names=frozenset(zaxes),
         check_vma=False,
     )
     return jax.jit(

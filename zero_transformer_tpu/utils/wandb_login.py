@@ -1,0 +1,105 @@
+"""wandb credential distribution for multi-host pods.
+
+The reference ships a one-shot CLI that logs every TPU host into wandb
+before a pod run (reference ``login.py:20-22``). TPU-native equivalent,
+import-gated (wandb optional in this tree):
+
+- ``python -m zero_transformer_tpu.utils.wandb_login --key $KEY`` logs THIS
+  host in (writes the credential via ``wandb.login``; falls back to a
+  ~/.netrc entry when wandb isn't importable, which wandb reads on first
+  use).
+- ``--broadcast NAME --zone Z`` prints the one gcloud command that replays
+  the login on every worker of a TPU pod slice — credential distribution
+  without this package needing cluster-ssh machinery of its own.
+
+The key is resolved from ``--key``, then ``$WANDB_API_KEY``, then
+``--key-file``. Nothing is ever echoed back; the key only lands in the
+local credential store.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import stat
+import sys
+
+_NETRC_HOST = "api.wandb.ai"
+
+
+def _resolve_key(args) -> str:
+    if args.key:
+        return args.key
+    if os.environ.get("WANDB_API_KEY"):
+        return os.environ["WANDB_API_KEY"]
+    if args.key_file:
+        with open(args.key_file) as f:
+            return f.read().strip()
+    raise SystemExit(
+        "no API key: pass --key, set WANDB_API_KEY, or pass --key-file"
+    )
+
+
+def _netrc_login(key: str) -> str:
+    """Write the machine entry wandb's client reads — the no-import path."""
+    path = os.path.expanduser("~/.netrc")
+    lines = []
+    if os.path.exists(path):
+        with open(path) as f:
+            content = f.read().splitlines()
+        skip = False
+        for line in content:
+            head = line.strip().split(" ", 1)[0]
+            # a new netrc entry starts at machine/default/macdef — any of
+            # them ends the skipped wandb block (dropping only OUR entry)
+            if head in ("machine", "default", "macdef"):
+                skip = head == "machine" and _NETRC_HOST in line
+            if not skip:
+                lines.append(line)
+    lines += [f"machine {_NETRC_HOST}", "  login user", f"  password {key}"]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.chmod(path, stat.S_IRUSR | stat.S_IWUSR)
+    return path
+
+
+def login(key: str) -> str:
+    """Log this host in; returns a human-readable description of what stuck."""
+    try:
+        import wandb
+
+        wandb.login(key=key, relogin=True)
+        return "wandb.login ok"
+    except ImportError:
+        return f"wandb not installed; wrote {_netrc_login(key)}"
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--key", default=None, help="wandb API key")
+    p.add_argument("--key-file", default=None, help="file containing the key")
+    p.add_argument(
+        "--broadcast",
+        default=None,
+        metavar="TPU_NAME",
+        help="print the gcloud command that runs this login on all pod workers",
+    )
+    p.add_argument("--zone", default=None, help="GCE zone for --broadcast")
+    args = p.parse_args(argv)
+
+    if args.broadcast:
+        # resolve NOW so --key/--key-file work too (not just an exported
+        # env var); the printed command necessarily carries the key — same
+        # trust model as typing it into gcloud yourself
+        key = _resolve_key(args)
+        zone = f" --zone={args.zone}" if args.zone else ""
+        print(
+            f"gcloud compute tpus tpu-vm ssh {args.broadcast}{zone} --worker=all "
+            f'--command="python -m zero_transformer_tpu.utils.wandb_login '
+            f'--key {key}"'
+        )
+        return
+    print(login(_resolve_key(args)), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
