@@ -17,11 +17,13 @@ block >300s) is killed and recorded instead of taking the whole capture down
   1. TPU, 580M, remat on    (the memory-safe configuration — runs FIRST so a
      good number always lands before risky upside experiments; round-2 ran
      the OOM-prone remat-off config first and lost the artifact)
-  2. TPU, 580M, remat off   (upside experiment; smaller per-step batch so it
+  2. TPU, 580M, remat with the "dots" policy (saves matmul outputs,
+     recomputes only elementwise — faster bwd if it fits)
+  3. TPU, 580M, remat off   (upside experiment; smaller per-step batch so it
      has a chance of fitting 16 GB v5e HBM, same 64k tokens/step via accum)
-  3. TPU flash-attention microbenchmark sweep T in {1k,4k,8k,16k}
+  4. TPU flash-attention microbenchmark sweep T in {1k,4k,8k,16k}
      (extra; only after a TPU success)
-  4. CPU smoke fallback     (only if every TPU scenario failed)
+  5. CPU smoke fallback     (only if every TPU scenario failed)
 
 The parent always exits 0 with exactly ONE parseable JSON line; errors ride
 in ``extra.errors``. Every string embedded in the output is truncated to
@@ -103,13 +105,16 @@ def child_train() -> dict:
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     accum = int(os.environ.get("BENCH_ACCUM", "8"))
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "none")
     max_steps = int(os.environ.get("BENCH_STEPS", "10"))
     min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", "45"))
 
     platform = jax.default_backend()
     print(f"devices_ok platform={platform} n={jax.device_count()}", file=sys.stderr)
 
-    cfg = model_config(model_name, dropout=0.0, remat=remat)
+    cfg = model_config(
+        model_name, dropout=0.0, remat=remat, remat_policy=remat_policy
+    )
     n_chips = jax.device_count()
     mesh = make_mesh(MeshConfig(zero_stage=1))
     model = Transformer(cfg)
@@ -163,10 +168,21 @@ def child_train() -> dict:
         "step_seconds": round(dt / n_steps, 3),
         "compile_seconds": round(t_compile, 1),
         "remat": remat,
+        "remat_policy": remat_policy,
         "n_chips": n_chips,
         "loss_finite": bool(loss == loss),
         "device_kind": jax.devices()[0].device_kind,
     }
+
+
+def child_loader() -> dict:
+    """Tar-gzip loader throughput + prefetch-overlap microbench (CPU-only;
+    no jax). See ``zero_transformer_tpu.data.loader_bench``."""
+    from zero_transformer_tpu.data.loader_bench import run
+
+    out = run()
+    out["ok"] = True
+    return out
 
 
 def child_flash() -> dict:
@@ -281,7 +297,10 @@ def main() -> None:
     scenario = os.environ.get("BENCH_CHILD")
     if scenario:  # ---- child mode: run one measurement, print its JSON
         try:
-            result = child_flash() if scenario == "flash" else child_train()
+            result = {
+                "flash": child_flash,
+                "loader": child_loader,
+            }.get(scenario, child_train)()
         except Exception as e:
             # XLA OOMs stringify to hundreds of KB — truncate HERE, at the
             # source, so no oversized string ever enters the artifact path.
@@ -298,12 +317,19 @@ def main() -> None:
     # number always lands before upside experiments (round-2 lesson). The
     # remat_off upside run uses half the per-step batch (same 64k tokens/step
     # via doubled accum) so its activation temporaries have a chance of
-    # fitting 16 GB v5e HBM.
-    for name, env_extra in (
-        ("remat_on", {"BENCH_REMAT": "1"}),
-        ("remat_off", {"BENCH_REMAT": "0", "BENCH_BATCH": "4", "BENCH_ACCUM": "16"}),
+    # fitting 16 GB v5e HBM. Upside scenarios get a SHORTER timeout: the
+    # known-good config compiles in ~2 min, so a config that can't compile
+    # in `upside_timeout` isn't going to win and must not eat the driver's
+    # budget (observed: the dots-policy compile can hang >30 min on the
+    # tunneled compile helper).
+    upside_timeout = float(os.environ.get("BENCH_UPSIDE_TIMEOUT", "420"))
+    for name, env_extra, timeout in (
+        ("remat_on", {"BENCH_REMAT": "1"}, tpu_timeout),
+        # upside experiments, in decreasing fit-probability order
+        ("remat_dots", {"BENCH_REMAT": "1", "BENCH_REMAT_POLICY": "dots"}, upside_timeout),
+        ("remat_off", {"BENCH_REMAT": "0", "BENCH_BATCH": "4", "BENCH_ACCUM": "16"}, upside_timeout),
     ):
-        res = _run_child("train", env_extra, tpu_timeout)
+        res = _run_child("train", env_extra, timeout)
         results[name] = res
         if not res.get("ok"):
             errors.append(_truncate(f"{name}: {res.get('error')}"))
@@ -322,13 +348,21 @@ def main() -> None:
         flash = _run_child("flash", {}, 600.0)
         if not flash.get("ok"):
             errors.append(_truncate(f"flash: {flash.get('error')}"))
+        loader = _run_child("loader", {"BENCH_PLATFORM": "cpu"}, 300.0)
+        if not loader.get("ok"):
+            errors.append(_truncate(f"loader: {loader.get('error')}"))
         out = {
             "metric": f"train_tokens_per_sec_per_chip_{best['model']}",
             "value": best["tok_s_chip"],
             "unit": "tokens/s/chip",
             "vs_baseline": round(best["tok_s_chip"] / BASELINE_TOK_S_CHIP, 3),
             "mfu": best.get("mfu"),
-            "extra": {"scenarios": results, "flash_microbench": flash, "errors": errors},
+            "extra": {
+                "scenarios": results,
+                "flash_microbench": flash,
+                "loader_microbench": loader,
+                "errors": errors,
+            },
         }
     else:
         # CPU fallback: tiny model, a real number from whatever backend exists

@@ -1,0 +1,30 @@
+"""train.py CLI plumbing: dotted --set overrides (reference analogue:
+``main_zero.py:41-55`` argparse + OmegaConf merge)."""
+import pytest
+
+from train import apply_overrides, parse_overrides
+from zero_transformer_tpu.config import Config
+
+
+def test_parse_literals_and_strings():
+    out = parse_overrides(["a.b=3", "c.d=0.5", "e.f=True", "g.h=/tmp/x"])
+    assert out == {"a.b": 3, "c.d": 0.5, "e.f": True, "g.h": "/tmp/x"}
+
+
+def test_apply_dotted_override():
+    cfg = apply_overrides(Config(), {"training.total_steps": 7, "mesh.pipe": 2})
+    assert cfg.training.total_steps == 7 and cfg.mesh.pipe == 2
+
+
+def test_unknown_field_raises():
+    with pytest.raises(ValueError, match="unknown config field"):
+        apply_overrides(Config(), {"training.nope": 1})
+
+
+def test_model_size_zoo_lookup_keeps_other_model_overrides():
+    # model.size replaces the model section from the zoo, but model.*
+    # overrides must land ON TOP regardless of command-line order
+    cfg = apply_overrides(
+        Config(), {"model.remat": True, "model.size": "125m"}
+    )
+    assert cfg.model.name == "125m" and cfg.model.remat is True

@@ -99,8 +99,9 @@ def test_scan_and_loop_layers_match():
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_l), atol=2e-5)
 
 
-def test_remat_matches_no_remat():
-    cfg = dataclasses.replace(TEST_CFG, remat=True)
+@pytest.mark.parametrize("policy", ["none", "dots"])
+def test_remat_matches_no_remat(policy):
+    cfg = dataclasses.replace(TEST_CFG, remat=True, remat_policy=policy)
     model_r = Transformer(cfg)
     model_n = Transformer(TEST_CFG)
     x = jnp.zeros((1, 8), jnp.int32)
@@ -108,6 +109,17 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(
         np.asarray(model_r.apply(params, x)), np.asarray(model_n.apply(params, x)), atol=1e-6
     )
+    # gradients under the policy must match too (the policy changes what is
+    # saved vs recomputed, never the math)
+    def loss(m):
+        def f(p):
+            return jnp.sum(m.apply(p, x).astype(jnp.float32) ** 2)
+        return f
+
+    gr = jax.grad(loss(model_r))(params)
+    gn = jax.grad(loss(model_n))(params)
+    for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(gn)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
 def test_gqa_llama_variant():

@@ -29,6 +29,15 @@ def parse_overrides(pairs):
 
 
 def apply_overrides(cfg, overrides: dict):
+    # model.size applies FIRST (a zoo lookup replaces the whole model
+    # section), so model.* overrides — wherever they appear on the command
+    # line — land on top of the zoo entry instead of being clobbered by it
+    if "model.size" in overrides:
+        from zero_transformer_tpu.config import model_config
+
+        cfg = dataclasses.replace(
+            cfg, model=model_config(str(overrides.pop("model.size")))
+        )
     for dotted, value in overrides.items():
         section_name, _, field = dotted.partition(".")
         section = getattr(cfg, section_name)

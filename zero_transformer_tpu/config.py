@@ -59,9 +59,24 @@ class ModelConfig:
     # stacked [n_layers, ...] param layout that ZeRO shards cleanly.
     scan_layers: bool = True
     remat: bool = False  # jax.checkpoint each block: trade FLOPs for HBM
+    # what the per-block checkpoint SAVES: "none" = save nothing (max HBM
+    # savings, recomputes the whole block in bwd); "dots" = save matmul
+    # outputs, recompute only elementwise/norm/softmax (jax
+    # dots_with_no_batch_dims_saveable — cheaper bwd for ~1 extra
+    # activations-worth of HBM per block)
+    remat_policy: str = "none"
     attention_impl: str = "auto"  # "auto" | "xla" | "flash" (pallas)
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    # Mixture-of-Experts (0 = dense MLP everywhere). With n_experts > 0 every
+    # block's MLP becomes a top-k routed expert mixture with capacity-based
+    # dispatch; expert weights shard over the mesh's `expert` axis (EP).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    # per-expert buffer = capacity_factor * top_k * tokens / n_experts
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01  # load-balance aux loss weight
+    router_z_coef: float = 1e-3  # router z-loss weight
 
     @property
     def kv_heads(self) -> int:
@@ -87,6 +102,8 @@ class ModelConfig:
         h, kv, hd = self.n_heads, self.kv_heads, self.head_width
         attn = d * h * hd + 2 * d * kv * hd + h * hd * d
         mlp = (3 if self.activation == "swiglu" else 2) * d * f
+        if self.n_experts > 0:
+            mlp = self.n_experts * mlp + d * self.n_experts  # experts + router
         norms = 2 * d
         per_layer = attn + mlp + norms
         embed = v * d * (1 if self.tie_embeddings else 2)
@@ -103,6 +120,14 @@ class ModelConfig:
             raise ValueError(f"invalid activation {self.activation!r}")
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(f"invalid norm {self.norm!r}")
+        if self.remat_policy not in ("none", "dots"):
+            raise ValueError(f"invalid remat_policy {self.remat_policy!r}")
+        if self.n_experts < 0:
+            raise ValueError("n_experts must be >= 0")
+        if self.n_experts > 0 and self.moe_top_k not in (1, 2):
+            raise ValueError("moe_top_k must be 1 or 2")
+        if self.n_experts > 0 and self.moe_top_k > self.n_experts:
+            raise ValueError("moe_top_k cannot exceed n_experts")
         if self.attention_impl not in ("auto", "xla", "flash"):
             raise ValueError(f"invalid attention_impl {self.attention_impl!r}")
         resolve_dtype(self.param_dtype)
@@ -112,14 +137,17 @@ class ModelConfig:
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout. Axes: data (DP+ZeRO), fsdp (param shard for ZeRO-3),
-    tensor (Megatron TP), sequence (ring-attention context parallelism).
+    expert (MoE expert parallelism), tensor (Megatron TP), sequence
+    (ring-attention context parallelism).
 
     The reference uses a 1-D ``("dp",)`` mesh only (reference ``main_zero.py:227-228``).
     """
 
     data: int = -1  # -1: use all remaining devices
     fsdp: int = 1
+    expert: int = 1
     tensor: int = 1
+    pipe: int = 1  # GPipe pipeline stages (layer sharding + ppermute wavefront)
     sequence: int = 1
     # ZeRO stage: 0 = plain DP, 1 = opt-state sharded, 2 = +grad reduce-scatter,
     # 3 = +param sharded (FSDP). Reference implements stage 1 only (SURVEY §2).

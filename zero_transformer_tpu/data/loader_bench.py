@@ -1,0 +1,116 @@
+"""Loader-throughput microbenchmark for the tar-gzip data path.
+
+Answers two questions the training-step bench can't:
+1. raw decode throughput of ``TarShardSource`` (gzip + tar + numpy decode),
+   in rows/s and MB/s — the ceiling the data pipeline puts on training;
+2. how much of a simulated device step the ``DataLoader``'s background
+   prefetch actually hides (sync vs prefetch wall time per step).
+
+The reference overlapped host decode with device compute via torch
+DataLoader workers (reference ``main_zero.py:407-421``); here the same
+overlap comes from ``DataLoader(prefetch=N)``. Run directly::
+
+    python -m zero_transformer_tpu.data.loader_bench
+
+or via ``bench.py`` (rides in the ``extra.loader_microbench`` field).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+import tempfile
+import time
+
+import numpy as np
+
+from zero_transformer_tpu.data.loader import DataLoader
+from zero_transformer_tpu.data.tarshards import TarShardSource
+
+
+def make_shards(
+    directory: str,
+    n_shards: int = 4,
+    rows_per_shard: int = 128,
+    max_context: int = 2048,
+    seed: int = 0,
+) -> list:
+    """Write gzipped tar shards of .npy token rows (webdataset layout)."""
+    rng = np.random.default_rng(seed)
+    paths = []
+    for s in range(n_shards):
+        path = os.path.join(directory, f"shard-{s:05d}.tar.gz")
+        with tarfile.open(path, "w:gz") as tar:
+            for r in range(rows_per_shard):
+                row = rng.integers(0, 50304, max_context).astype(np.uint16)
+                buf = io.BytesIO()
+                np.save(buf, row)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=f"{s:05d}-{r:05d}.input_id.npy")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        paths.append(path)
+    return paths
+
+
+def run(
+    n_shards: int = 4,
+    rows_per_shard: int = 128,
+    max_context: int = 2048,
+    batch_rows: int = 8,
+    simulated_step_s: float = 0.02,
+) -> dict:
+    with tempfile.TemporaryDirectory(prefix="zt_loader_bench") as tmp:
+        shards = make_shards(tmp, n_shards, rows_per_shard, max_context)
+        total_rows = n_shards * rows_per_shard
+        n_steps = total_rows // batch_rows - 1  # one epoch, minus warmup slack
+
+        # 1. raw source decode throughput
+        src = TarShardSource(shards, max_context=max_context, shuffle_shards=False)
+        it = iter(src)
+        next(it)  # open/first-decode warmup
+        t0 = time.perf_counter()
+        for _ in range(total_rows - 1):
+            next(it)
+        dt = time.perf_counter() - t0
+        rows_s = (total_rows - 1) / dt
+        mb_s = rows_s * max_context * 2 / 1e6  # uint16 payload bytes
+
+        # 2. overlap: consumer "computes" simulated_step_s per batch
+        def consume(prefetch: int) -> float:
+            src = TarShardSource(
+                shards, max_context=max_context, shuffle_shards=False
+            )
+            dl = DataLoader(
+                src, batch_size=batch_rows, train_context=max_context,
+                process_index=0, process_count=1, prefetch=prefetch,
+            )
+            it = iter(dl)
+            next(it)  # warmup: spin up producer / first decode
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                next(it)
+                time.sleep(simulated_step_s)
+            return (time.perf_counter() - t0) / n_steps
+
+        sync_s = consume(0)
+        pre_s = consume(2)
+        return {
+            "decode_rows_per_s": round(rows_s, 1),
+            "decode_MB_per_s": round(mb_s, 1),
+            "simulated_step_s": simulated_step_s,
+            "sync_step_s": round(sync_s, 4),
+            "prefetch_step_s": round(pre_s, 4),
+            # 1.0 = prefetch hides ALL decode time behind the step; None
+            # when sync decode is already ~free (metric would be noise)
+            "decode_hidden_frac": (
+                round(max(0.0, min(1.0, (sync_s - pre_s) / (sync_s - simulated_step_s))), 3)
+                if sync_s - simulated_step_s > 1e-3
+                else None
+            ),
+        }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))

@@ -158,7 +158,11 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm transformer block (reference ``GPT.py:16-50``)."""
+    """Pre-norm transformer block (reference ``GPT.py:16-50``).
+
+    Carry is ``(x, aux)``: MoE blocks add their router auxiliary loss to
+    ``aux`` as it threads through the layer scan; dense blocks pass it
+    through unchanged."""
 
     cfg: ModelConfig
     deterministic: bool = True
@@ -167,17 +171,27 @@ class Block(nn.Module):
     mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, _=None):
+    def __call__(self, carry, _=None):
         cfg = self.cfg
+        x, aux = carry
         x = x + Attention(
             cfg, self.deterministic, self.decode, self.cache_len, self.mesh, name="attn"
         )(
             _norm(cfg, x.dtype, "ln_attn")(x)
         )
-        x = x + MLP(cfg, self.deterministic, name="mlp")(
-            _norm(cfg, x.dtype, "ln_mlp")(x)
-        )
-        return x, None
+        if cfg.n_experts > 0:
+            from zero_transformer_tpu.models.moe import MoEMLP
+
+            mo, layer_aux = MoEMLP(cfg, self.deterministic, name="moe")(
+                _norm(cfg, x.dtype, "ln_mlp")(x)
+            )
+            x = x + mo
+            aux = aux + layer_aux
+        else:
+            x = x + MLP(cfg, self.deterministic, name="mlp")(
+                _norm(cfg, x.dtype, "ln_mlp")(x)
+            )
+        return (x, aux), None
 
 
 class Transformer(nn.Module):
@@ -244,7 +258,18 @@ class Transformer(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, prevent_cse=not cfg.scan_layers)
+            # "dots": save matmul outputs, recompute only cheap elementwise
+            # ops in the backward — a faster point on the remat memory/FLOPs
+            # curve than save-nothing when HBM allows
+            policy = (
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                if cfg.remat_policy == "dots"
+                else None
+            )
+            block_cls = nn.remat(
+                Block, prevent_cse=not cfg.scan_layers, policy=policy
+            )
+        aux = jnp.zeros((), jnp.float32)  # MoE router losses, summed over layers
         if cfg.scan_layers:
             stack = nn.scan(
                 block_cls,
@@ -253,13 +278,13 @@ class Transformer(nn.Module):
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, not train, self.decode, self.cache_len, self.mesh, name="blocks")
-            h, _ = stack(h, None)
+            (h, aux), _ = stack((h, aux), None)
         else:
             for i in range(cfg.n_layers):
-                h, _ = block_cls(
+                (h, aux), _ = block_cls(
                     cfg, not train, self.decode, self.cache_len, self.mesh,
                     name=f"block_{i}",
-                )(h, None)
+                )((h, aux), None)
 
         h = _norm(cfg, h.dtype, "ln_f")(h)
 
@@ -272,4 +297,9 @@ class Transformer(nn.Module):
 
         if labels is None:
             return logits
-        return logits, next_token_loss(logits, labels)
+        loss = next_token_loss(logits, labels)
+        if train and cfg.n_experts > 0:
+            # router losses steer TRAINING only; eval loss stays pure CE so
+            # perplexities remain comparable to dense models
+            loss = loss + aux
+        return logits, loss

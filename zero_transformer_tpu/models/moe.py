@@ -1,0 +1,159 @@
+"""Mixture-of-Experts MLP with top-k routing and expert parallelism.
+
+Beyond the reference (which is dense-only; SURVEY §2 checklist: EP/MoE =
+none). TPU-first design choices:
+
+- **einsum dispatch** (GShard/Switch formulation): routing builds one-hot
+  dispatch/combine tensors ``[B, T, E, C]`` and moves tokens with two
+  einsums. Static shapes, no gather/scatter, MXU-friendly — XLA lowers the
+  expert-dim resharding to an all-to-all when the ``expert`` mesh axis is
+  active (capacity C bounds the per-expert buffer, so the communication
+  volume is fixed at trace time).
+- **capacity-based top-k** (k ∈ {1, 2}): per-expert queue positions come
+  from a cumulative sum over the token axis; overflowing tokens are dropped
+  (their residual path passes through unchanged) — the standard
+  fixed-capacity contract that keeps every shape static under jit.
+- **router in float32** with a load-balance auxiliary loss (Switch: E ·
+  Σ_e fraction_e · prob_e over first-choice assignments) and a router
+  z-loss; both are returned to the caller and added to the training loss
+  only (never to eval perplexity).
+- expert weights are stacked ``[E, d, f]`` with the ``expert`` logical axis
+  → sharded over the mesh's ``expert`` axis (EP) and composable with
+  Megatron TP on the ``mlp`` axis within each expert.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers
+
+from zero_transformer_tpu.config import ModelConfig, resolve_dtype
+
+
+def _routing(
+    logits: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k capacity-limited assignment.
+
+    Args:
+      logits: [B, T, E] float32 router scores.
+      top_k: 1 (Switch: output scaled by raw router prob) or 2 (GShard:
+        weights renormalized over the chosen pair).
+      capacity: per-expert queue length C.
+
+    Returns (dispatch [B,T,E,C] 0/1, combine [B,T,E,C], aux) where aux is
+    the Switch load-balance loss (coefficient-free; caller scales).
+    """
+    B, T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    p = probs
+    masks, gates = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B, T, E]
+        gates.append(jnp.sum(p * m, axis=-1))  # [B, T]
+        masks.append(m)
+        p = p * (1.0 - m)
+
+    if top_k == 1:
+        weights = gates  # Switch: scale by the raw router probability
+    else:
+        denom = sum(gates) + 1e-9
+        weights = [g / denom for g in gates]
+
+    dispatch = jnp.zeros((B, T, E, capacity), jnp.float32)
+    combine = jnp.zeros((B, T, E, capacity), jnp.float32)
+    queued = jnp.zeros((B, 1, E), jnp.float32)  # tokens enqueued per expert
+    for m, w in zip(masks, weights):
+        pos = jnp.cumsum(m, axis=1) - m + queued  # queue slot per token
+        keep = m * (pos < capacity)
+        queued = queued + jnp.cumsum(m, axis=1)[:, -1:, :]
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        slot = slot * keep[..., None]  # [B, T, E, C]
+        dispatch = dispatch + slot
+        combine = combine + slot * w[:, :, None, None]
+
+    # load balance over FIRST choices (Switch §2.2): E * Σ_e f_e * P_e
+    f = jnp.mean(masks[0], axis=(0, 1))  # fraction routed to e
+    pmean = jnp.mean(probs, axis=(0, 1))  # mean router prob for e
+    aux = E * jnp.sum(f * pmean)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: returns (output, aux_loss)."""
+
+    cfg: ModelConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        dtype = x.dtype
+        param_dtype = resolve_dtype(cfg.param_dtype)
+        B, T, d = x.shape
+        E, k, f = cfg.n_experts, cfg.moe_top_k, cfg.ff_dim
+        C = max(1, int(cfg.capacity_factor * k * T / E))
+        resid_std = 0.02 / (2 * cfg.n_layers) ** 0.5
+
+        router = self.param(
+            "router",
+            nn.with_partitioning(initializers.normal(stddev=0.02), ("embed", None)),
+            (d, E),
+            param_dtype,
+        )
+        # router math in f32: routing decisions are precision-sensitive (the
+        # same discipline as the f32 softmax, reference ``layers.py:167-173``)
+        logits = jnp.einsum(
+            "btd,de->bte", x, router, preferred_element_type=jnp.float32
+        )
+        dispatch, combine, balance = _routing(logits, k, C)
+        zloss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        aux = (
+            jnp.float32(cfg.router_aux_coef) * balance
+            + jnp.float32(cfg.router_z_coef) * zloss
+        )
+
+        # stacked expert weights; `expert` logical axis → EP mesh axis
+        wi = self.param(
+            "wi",
+            nn.with_partitioning(
+                initializers.normal(stddev=0.02), ("expert", "embed", "mlp")
+            ),
+            (E, d, f),
+            param_dtype,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_partitioning(
+                initializers.normal(stddev=resid_std), ("expert", "mlp", "embed")
+            ),
+            (E, f, d),
+            param_dtype,
+        )
+
+        # dispatch: [B,T,d] tokens -> [E,B,C,d] expert buffers (all-to-all
+        # over the expert axis when sharded)
+        xin = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)
+        h = jnp.einsum("ebcd,edf->ebcf", xin, wi.astype(dtype))
+        if cfg.activation == "swiglu":
+            wg = self.param(
+                "gate",
+                nn.with_partitioning(
+                    initializers.normal(stddev=0.02), ("expert", "embed", "mlp")
+                ),
+                (E, d, f),
+                param_dtype,
+            )
+            g = jnp.einsum("ebcd,edf->ebcf", xin, wg.astype(dtype))
+            h = nn.silu(g) * h
+        else:
+            h = nn.gelu(h)
+        out_e = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(dtype))
+        out = jnp.einsum("btec,ebcd->btd", combine.astype(dtype), out_e)
+        out = nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
+        return out, aux

@@ -1,12 +1,14 @@
 """Device mesh construction.
 
 Replaces the reference's 1-D ``Mesh(jax.devices(), ("dp",))``
-(reference ``main_zero.py:227-228``) with a named 4-axis mesh:
+(reference ``main_zero.py:227-228``) with a named 6-axis mesh:
 
 - ``data``: data parallelism (+ ZeRO sharding axis)
 - ``fsdp``: parameter-shard axis for ZeRO-3/FSDP layouts
+- ``expert``: expert parallelism (MoE layers; all-to-all dispatch)
 - ``tensor``: Megatron tensor parallelism
 - ``sequence``: ring-attention context parallelism
+- ``pipe``: GPipe pipeline parallelism (layer stages; ppermute wavefront)
 
 Axes of size 1 cost nothing; collectives lower onto ICI via GSPMD.
 """
@@ -23,9 +25,11 @@ from zero_transformer_tpu.config import MeshConfig
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+EXPERT_AXIS = "expert"
 TENSOR_AXIS = "tensor"
 SEQUENCE_AXIS = "sequence"
-AXES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQUENCE_AXIS)
+PIPE_AXIS = "pipe"
+AXES = (PIPE_AXIS, DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, TENSOR_AXIS, SEQUENCE_AXIS)
 
 
 def make_mesh(
@@ -36,15 +40,19 @@ def make_mesh(
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    fixed = cfg.fsdp * cfg.tensor * cfg.sequence
+    fixed = cfg.pipe * cfg.fsdp * cfg.expert * cfg.tensor * cfg.sequence
     if n % fixed:
-        raise ValueError(f"{n} devices not divisible by fsdp*tensor*sequence={fixed}")
+        raise ValueError(
+            f"{n} devices not divisible by pipe*fsdp*expert*tensor*sequence={fixed}"
+        )
     data = cfg.data if cfg.data != -1 else n // fixed
     if data * fixed != n:
         raise ValueError(
-            f"mesh {data}x{cfg.fsdp}x{cfg.tensor}x{cfg.sequence} != {n} devices"
+            f"mesh {cfg.pipe}x{data}x{cfg.fsdp}x{cfg.expert}x{cfg.tensor}"
+            f"x{cfg.sequence} != {n} devices"
         )
-    shape = (data, cfg.fsdp, cfg.tensor, cfg.sequence)
+    # pipe leads: stage boundaries land on the slowest interconnect dimension
+    shape = (cfg.pipe, data, cfg.fsdp, cfg.expert, cfg.tensor, cfg.sequence)
     try:
         # topology-aware placement: keeps collective-heavy axes on adjacent
         # ICI links on real TPU slices

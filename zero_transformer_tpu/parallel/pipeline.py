@@ -1,0 +1,243 @@
+"""GPipe pipeline parallelism: layer stages over the ``pipe`` mesh axis.
+
+Beyond the reference (SURVEY §2 checklist: PP = none). TPU-first design:
+
+- the stacked ``[n_layers, ...]`` block params (``nn.scan`` layout) shard
+  their layer dim over ``pipe`` (``sharding.LOGICAL_RULES["layers"]``), so
+  each stage owns ``n_layers / pipe`` contiguous layers with NO parameter
+  movement — stage locality falls out of the sharding;
+- the microbatch wavefront is a ``lax.fori_loop`` of ``M + P - 1`` ticks
+  under a PARTIAL-MANUAL ``shard_map`` (manual over ``pipe`` only):
+  activations hop stages via ``ppermute`` (neighbor ICI traffic), while the
+  data/tensor/expert axes stay auto so GSPMD still handles DP gradient
+  reduction, Megatron TP, and MoE dispatch inside each stage;
+- backward is plain ``jax.grad`` through the loop (``ppermute`` transposes
+  to the reverse hop), giving the GPipe fill-drain schedule; per-block
+  rematerialization (``cfg.remat``) bounds the stashed activations;
+- every rank runs identical code; rank-dependent work (embed on the first
+  stage, head + loss on the last) is selected with ``where`` masks — no
+  divergent control flow, one compiled program (SPMD).
+
+The bubble fraction is the textbook (P-1)/(M+P-1): gradient-accumulation
+microbatches ARE the pipeline microbatches.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zero_transformer_tpu.config import resolve_dtype
+from zero_transformer_tpu.ops.losses import next_token_loss
+from zero_transformer_tpu.parallel.mesh import PIPE_AXIS
+
+
+def _pipe_part(spec: P) -> P:
+    """Keep only the ``pipe`` entries of a spec (manual axis); every other
+    axis stays auto under the partial-manual shard_map."""
+
+    def keep(e):
+        if e is None:
+            return None
+        names = set(e) if isinstance(e, tuple) else {e}
+        return e if names <= {PIPE_AXIS} else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def make_pp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    plan,
+    zero_stage: int = 1,
+    schedule: Optional[Callable] = None,
+) -> Callable:
+    """Fused train step for meshes with an active ``pipe`` axis.
+
+    Same signature/contract as ``zero.make_train_step``: ``(state, batch,
+    rng) -> (state, metrics)`` with ``batch`` int32 [M, global_batch, seq]
+    — the leading gradient-accumulation axis doubles as the pipeline
+    microbatch axis, so M also sets the bubble fraction.
+
+    Supports ZeRO stage 0/1 (optimizer-state sharding via GSPMD on the auto
+    data axis). Stages >= 2 are rejected: their guarantees come from the
+    explicit collective core in ``zero.py``, which cannot nest inside the
+    pipe-manual region.
+    """
+    from zero_transformer_tpu.models.gpt import Block, _dense, _norm
+    from zero_transformer_tpu.parallel.mesh import TENSOR_AXIS
+    from zero_transformer_tpu.parallel.zero import TrainState
+
+    cfg = model.cfg
+    n_stages = mesh.shape[PIPE_AXIS]
+    if zero_stage >= 2:
+        raise NotImplementedError(
+            "pipeline parallelism supports ZeRO stage 0/1; the explicit "
+            "stage-2/3 collective core does not compose with the pipe axis"
+        )
+    if mesh.shape[TENSOR_AXIS] > 1:
+        # XLA's SPMD partitioner CHECK-fails (spmd_partitioner_util.cc:495)
+        # partitioning auto tensor-sharded ops inside a pipe-manual shard_map
+        # region (jax 0.9.0 / CPU backend; reproduced, not a logic error
+        # here). Fail loudly instead of crashing the process.
+        raise NotImplementedError(
+            "pipe x tensor meshes currently crash XLA's SPMD partitioner; "
+            "use pipe with data/fsdp/expert axes"
+        )
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True")
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe={n_stages}"
+        )
+    if cfg.position == "learned":
+        raise NotImplementedError(
+            "pipeline parallelism supports alibi/rope positions"
+        )
+    l_local = cfg.n_layers // n_stages
+    dtype = resolve_dtype(cfg.compute_dtype)
+    param_dtype = resolve_dtype(cfg.param_dtype)
+
+    # the SAME module classes the plain Transformer is built from, applied
+    # piecewise against param subtrees — no re-implemented math
+    embed_mod = nn.Embed(
+        num_embeddings=cfg.vocab_size,
+        features=cfg.d_model,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )
+    norm_mod = _norm(cfg, dtype, "ln_f")
+    head_mod = (
+        None
+        if cfg.tie_embeddings
+        else _dense(cfg.vocab_size, ("embed", "vocab"), 0.02, dtype, param_dtype, "lm_head")
+    )
+    block_cls = Block
+    if cfg.remat:
+        # same per-block checkpointing (and policy) as the plain path
+        # (models/gpt.py) — bounds the activations stashed across the
+        # M+P-1 wavefront ticks
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if cfg.remat_policy == "dots"
+            else None
+        )
+        block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
+    stage_mod = nn.scan(
+        block_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        length=l_local,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )(cfg, False, False, None, None)  # deterministic=False: train step
+
+    def core(params, batch, rng):
+        rank = jax.lax.axis_index(PIPE_AXIS)
+        M = batch.shape[0]
+        n_ticks = M + n_stages - 1
+
+        def embed_mb(i):
+            x = batch[jnp.clip(i, 0, M - 1)]
+            return embed_mod.apply({"params": params["wte"]}, x)
+
+        def head_loss_mb(h, i):
+            x = batch[jnp.clip(i, 0, M - 1)]
+            h = norm_mod.apply({"params": params["ln_f"]}, h)
+            if cfg.tie_embeddings:
+                logits = embed_mod.apply(
+                    {"params": params["wte"]}, h, method="attend"
+                )
+            else:
+                logits = head_mod.apply({"params": params["lm_head"]}, h)
+            return next_token_loss(logits, x)
+
+        def tick(carry, t):
+            outbox, loss_sum, aux_sum = carry
+            # activations hop to the next stage; the wrap-around edge
+            # (last -> first) always carries an inactive bubble slot
+            inbox = jax.lax.ppermute(
+                outbox,
+                PIPE_AXIS,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            mb = t - rank  # microbatch this rank works on at tick t
+            h_in = jnp.where(rank == 0, embed_mb(t), inbox)
+            mrng = jax.random.fold_in(jax.random.fold_in(rng, mb), rank)
+            (h_out, aux), _ = stage_mod.apply(
+                {"params": params["blocks"]},
+                (h_in.astype(dtype), jnp.zeros((), jnp.float32)),
+                rngs={"dropout": mrng},
+            )
+            mb_done = t - (n_stages - 1)  # microbatch finishing at the tail
+            loss_t = head_loss_mb(h_out, mb_done)
+            is_last = rank == n_stages - 1
+            loss_sum = loss_sum + jnp.where(
+                is_last & (mb_done >= 0), loss_t, 0.0
+            )
+            aux_sum = aux_sum + jnp.where((mb >= 0) & (mb < M), aux, 0.0)
+            return (h_out, loss_sum, aux_sum), None
+
+        h0 = embed_mb(0) * 0.0  # bubble payload; shape [b, T, d]
+        # scan, not fori_loop: the wavefront must be reverse-differentiable
+        # (grad through it produces the GPipe drain schedule)
+        (_, loss_sum, aux_sum), _ = jax.lax.scan(
+            tick,
+            (h0.astype(dtype), jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            jnp.arange(n_ticks),
+        )
+        loss = jax.lax.psum(loss_sum, PIPE_AXIS) / M
+        if cfg.n_experts > 0:
+            loss = loss + jax.lax.psum(aux_sum, PIPE_AXIS) / M
+        return loss
+
+    param_specs = jax.tree.map(lambda ns: _pipe_part(ns.spec), plan.state.params)
+    pp_loss = shard_map(
+        core,
+        mesh=mesh,
+        in_specs=(param_specs, P(), P()),
+        out_specs=P(),
+        axis_names=frozenset({PIPE_AXIS}),
+        check_vma=False,
+    )
+
+    def constrain_zero(tree):
+        return jax.lax.with_sharding_constraint(tree, plan.zero)
+
+    def train_step(state: TrainState, batch: jax.Array, rng: jax.Array):
+        step_rng = jax.random.fold_in(rng, state.step)
+        loss, grads = jax.value_and_grad(
+            lambda p: pp_loss(p, batch, step_rng)
+        )(state.params)
+        grad_norm = optax.global_norm(grads)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        if zero_stage >= 1:
+            updates = constrain_zero(updates)
+        new_params = optax.apply_updates(state.params, updates)
+        new_params = jax.lax.with_sharding_constraint(
+            new_params, plan.state.params
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "tokens": jnp.asarray(batch.size, jnp.float32),
+        }
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.step)
+        return (
+            TrainState(step=state.step + 1, params=new_params, opt_state=new_opt),
+            metrics,
+        )
+
+    batch_shard = NamedSharding(mesh, P(None, *plan.batch.spec))
+    return jax.jit(
+        train_step,
+        in_shardings=(plan.state, batch_shard, NamedSharding(mesh, P())),
+        out_shardings=(plan.state, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )

@@ -28,21 +28,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zero_transformer_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     FSDP_AXIS,
+    PIPE_AXIS,
     SEQUENCE_AXIS,
     TENSOR_AXIS,
     zero_axes,
 )
 
 # logical axis name -> mesh axis (None = replicated). Megatron layout:
-# qkv/mlp-in sharded on the output feature axis, out-proj/mlp-out on input.
+# qkv/mlp-in sharded on the output feature axis, out-proj/mlp-out on input;
+# MoE expert stacks shard over the expert axis (EP); the stacked layer dim
+# shards over the pipe axis (each pipeline stage owns n_layers/pipe layers).
 LOGICAL_RULES: dict[str, Optional[str]] = {
     "vocab": TENSOR_AXIS,
     "qheads": TENSOR_AXIS,
     "kvheads": TENSOR_AXIS,
     "mlp": TENSOR_AXIS,
+    "expert": EXPERT_AXIS,
     "embed": None,
-    "layers": None,
+    "layers": PIPE_AXIS,
 }
 
 

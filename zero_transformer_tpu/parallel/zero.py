@@ -139,7 +139,15 @@ def make_train_step(
     ``_make_explicit_zero_step``). With the sequence (ring-attention CP) axis
     active the GSPMD constraint-hint path below is used instead — the ring
     engine is itself a shard_map and does not nest under a manual ZeRO core.
+    An active ``pipe`` axis routes to the GPipe wavefront step
+    (``parallel.pipeline``).
     """
+    from zero_transformer_tpu.parallel.mesh import PIPE_AXIS
+
+    if mesh.shape[PIPE_AXIS] > 1:
+        from zero_transformer_tpu.parallel.pipeline import make_pp_train_step
+
+        return make_pp_train_step(model, tx, mesh, plan, zero_stage, schedule)
     if zero_stage >= 2 and mesh.shape[SEQUENCE_AXIS] == 1:
         return _make_explicit_zero_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory
