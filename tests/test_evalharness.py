@@ -181,6 +181,47 @@ def test_perplexity_and_bpb(model_and_params):
     )
 
 
+def test_eval_cli_end_to_end(model_and_params, tmp_path, capsys, monkeypatch):
+    """The `python -m zero_transformer_tpu.evalharness` driver: zoo model +
+    msgpack params + token JSONL -> one JSON result line."""
+    import json
+
+    import flax.linen as nn
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.evalharness import cli
+
+    # params for the zoo's "test" model, exported the way export.py does
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.models import Transformer
+
+    cfg = model_config("test", compute_dtype="float32", dropout=0.0)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    params_path = tmp_path / "p.msgpack"
+    params_path.write_bytes(msgpack_serialize(jax.tree.map(np.asarray, params)))
+
+    rng = np.random.default_rng(0)
+    data = tmp_path / "lambada.jsonl"
+    with open(data, "w") as f:
+        for _ in range(3):
+            f.write(json.dumps({
+                "context": [int(t) for t in rng.integers(1, 60, 6)],
+                "target": [int(t) for t in rng.integers(1, 60, 2)],
+            }) + "\n")
+
+    cli.main([
+        "--model", "test", "--params", str(params_path), "--task", "lambada",
+        "--data", str(data), "--seq-len", "16", "--batch-size", "2",
+        "--dtype", "float32",
+    ])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["task"] == "lambada" and out["examples"] == 3
+    assert out["ppl"] > 0
+
+
 def test_perplexity_batch_size_invariance(model_and_params):
     model, params = model_and_params
     rng = np.random.default_rng(5)

@@ -101,6 +101,27 @@ def test_single_expert_matches_dense_mlp():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_upcycle_dense_to_moe_preserves_function():
+    """Sparse upcycling: with every expert an exact copy of the donor MLP
+    and top-2 renormalized weights (w1+w2=1), the upcycled model must
+    compute the donor's function exactly (capacity high enough to drop
+    nothing)."""
+    import flax.linen as nn
+
+    from zero_transformer_tpu.utils.surgery import upcycle_moe
+
+    dense_cfg = dataclasses.replace(MOE_CFG, n_experts=0)
+    moe_cfg = dataclasses.replace(MOE_CFG, n_experts=4, moe_top_k=2,
+                                  capacity_factor=4.0)
+    x = jnp.asarray(np.random.default_rng(1).integers(0, 128, (2, 16)), jnp.int32)
+    dense = Transformer(dense_cfg)
+    dparams = nn.meta.unbox(dense.init(jax.random.PRNGKey(0), x)["params"])
+    mparams = upcycle_moe(dparams, n_experts=4)
+    ref = dense.apply({"params": dparams}, x)
+    out = Transformer(moe_cfg).apply({"params": mparams}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_moe_params_shard_over_expert_axis(devices):
     mesh = make_mesh(MeshConfig(data=2, expert=2, tensor=2))
     assert mesh.shape[EXPERT_AXIS] == 2

@@ -1,0 +1,3 @@
+from zero_transformer_tpu.evalharness.cli import main
+
+main()

@@ -1,0 +1,141 @@
+"""Eval CLI: run the in-tree tasks on a checkpoint from the command line.
+
+The reference's published numbers required exporting to PyTorch and running
+lm-eval-harness on a CUDA GPU (reference ``README.md:53-57``,
+``torch_compatability/``); this runs the same measurements on TPU in one
+command::
+
+  python -m zero_transformer_tpu.evalharness --model 1_3b --params p.msgpack \\
+      --task lambada --data lambada.jsonl --seq-len 1024
+
+Data is pre-tokenized JSONL (no tokenizer or network dependency):
+
+- ``lambada``:  {"context": [ids], "target": [ids]}            per line
+- ``choice``:   {"context": [ids], "choices": [[ids], ...],
+                 "gold": i, "choice_bytes": [n, ...]?}          per line
+- ``ppl``/``bpb``: one object {"tokens": [ids], "num_bytes": n?}
+  (or a raw ``.bin``/``.u16`` uint16 token file; pass --num-bytes for bpb)
+
+Pass ``--tokenizer <hf name/path>`` to instead accept text fields
+("context"/"target"/"choices" as strings), tokenized on the fly.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def _read_jsonl(path: str):
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def _tok(tokenizer, x, prefix_space: bool = False):
+    if tokenizer is None:
+        return list(x)
+    if isinstance(x, str):
+        # no BOS/EOS injection: continuations are scored token-for-token,
+        # so a tokenizer-added special token would be scored as if it were
+        # part of the target text
+        return tokenizer.encode(
+            (" " + x) if prefix_space else x, add_special_tokens=False
+        )
+    return list(x)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="TPU in-tree eval harness")
+    p.add_argument("--model", required=True, help="model zoo name")
+    p.add_argument("--params", required=True, help="params msgpack (see export)")
+    p.add_argument(
+        "--task", required=True, choices=["lambada", "choice", "ppl", "bpb"]
+    )
+    p.add_argument("--data", required=True, help="JSONL / token file (see docstring)")
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--num-bytes", type=int, default=None, help="UTF-8 bytes for bpb")
+    p.add_argument("--limit", type=int, default=None, help="cap example count")
+    p.add_argument("--tokenizer", default=None, help="HF tokenizer for text JSONL")
+    args = p.parse_args(argv)
+
+    from zero_transformer_tpu.checkpoint import import_params_msgpack
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.evalharness import (
+        choice_accuracy,
+        lambada,
+        perplexity,
+    )
+    from zero_transformer_tpu.models import Transformer
+
+    cfg = model_config(args.model, compute_dtype=args.dtype, dropout=0.0)
+    params = import_params_msgpack(args.params)
+    model = Transformer(cfg)
+    tokenizer = None
+    if args.tokenizer:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+
+    import itertools
+
+    def rows():
+        # bound the read AND the tokenization to what will be scored
+        return itertools.islice(_read_jsonl(args.data), args.limit)
+
+    if args.task in ("ppl", "bpb"):
+        if args.data.endswith((".bin", ".u16")):
+            tokens = np.fromfile(args.data, dtype=np.uint16).astype(np.int32)
+            num_bytes = args.num_bytes
+        else:
+            obj = json.loads(Path(args.data).read_text())
+            tokens = np.asarray(_tok(tokenizer, obj["tokens"]), np.int32)
+            num_bytes = obj.get("num_bytes", args.num_bytes)
+        if args.task == "bpb":
+            if not num_bytes:
+                raise SystemExit(
+                    "--task bpb needs the source byte count: pass --num-bytes "
+                    "or a num_bytes field in the data file"
+                )
+            if args.limit and args.limit < len(tokens):
+                raise SystemExit(
+                    "--limit with --task bpb would divide a truncated nll by "
+                    "the full document's bytes; truncate the data file instead"
+                )
+        if args.limit:
+            tokens = tokens[: args.limit]
+        out = perplexity(
+            model, params, tokens, args.seq_len, args.batch_size, num_bytes
+        )
+    elif args.task == "lambada":
+        examples = [
+            (_tok(tokenizer, r["context"]), _tok(tokenizer, r["target"], True))
+            for r in rows()
+        ]
+        out = lambada(model, params, examples, args.seq_len, args.batch_size)
+    else:  # choice
+        examples = []
+        for r in rows():
+            choices = [_tok(tokenizer, c, True) for c in r["choices"]]
+            byte_lens = r.get("choice_bytes")
+            if byte_lens is None and tokenizer is not None:
+                byte_lens = [len(str(c).encode()) for c in r["choices"]]
+            examples.append(
+                (_tok(tokenizer, r["context"]), choices, int(r["gold"]), byte_lens)
+            )
+        out = choice_accuracy(
+            model, params, examples, args.seq_len, args.batch_size
+        )
+
+    print(json.dumps({"task": args.task, "model": args.model, **out}))
+
+
+if __name__ == "__main__":
+    main()

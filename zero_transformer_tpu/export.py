@@ -54,6 +54,24 @@ def _cmd_extend(args) -> None:
     print(f"extended {old} -> {args.layers} layers -> {args.out}")
 
 
+def _cmd_upcycle(args) -> None:
+    import jax
+    import numpy as np
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.checkpoint import import_params_msgpack
+    from zero_transformer_tpu.utils.surgery import is_stacked, stack_blocks, upcycle_moe
+
+    params = import_params_msgpack(args.params)
+    if not is_stacked(params):
+        params = stack_blocks(params)
+    params = upcycle_moe(params, args.experts)
+    Path(args.out).write_bytes(
+        msgpack_serialize(jax.tree.map(np.asarray, params))
+    )
+    print(f"upcycled dense -> {args.experts} experts -> {args.out}")
+
+
 def _cmd_inspect(args) -> None:
     from zero_transformer_tpu.checkpoint import import_params_msgpack
     from zero_transformer_tpu.utils.surgery import is_stacked, num_layers
@@ -86,6 +104,14 @@ def main(argv=None) -> None:
     et.add_argument("--layers", type=int, required=True)
     et.add_argument("--out", required=True)
     et.set_defaults(fn=_cmd_extend)
+
+    up = sub.add_parser(
+        "upcycle", help="dense params -> MoE warm start (sparse upcycling)"
+    )
+    up.add_argument("--params", required=True)
+    up.add_argument("--experts", type=int, required=True)
+    up.add_argument("--out", required=True)
+    up.set_defaults(fn=_cmd_upcycle)
 
     ins = sub.add_parser("inspect", help="list tensors in a params msgpack")
     ins.add_argument("--params", required=True)

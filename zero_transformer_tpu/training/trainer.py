@@ -167,6 +167,17 @@ class Trainer:
         donor = ckpt_lib.import_params_msgpack(path)
         if surgery.num_layers(donor) != self.cfg.model.n_layers:
             donor = surgery.extend_depth(donor, self.cfg.model.n_layers)
+        if self.cfg.model.n_experts > 0:
+            # dense donor → MoE model: sparse upcycling. Runs BEFORE the
+            # layout conversion (upcycle_moe needs the stacked layout, and
+            # a scan_layers=False model would otherwise unstack first and
+            # skip this branch entirely).
+            stacked = surgery.stack_blocks(donor)
+            if "mlp" in stacked.get("blocks", {}):
+                donor = surgery.upcycle_moe(stacked, self.cfg.model.n_experts)
+                log.info(
+                    "upcycled dense donor to %d experts", self.cfg.model.n_experts
+                )
         if surgery.is_stacked(donor) != self.cfg.model.scan_layers:
             donor = (
                 surgery.stack_blocks(donor)

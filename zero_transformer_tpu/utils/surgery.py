@@ -8,7 +8,7 @@ embeddings / final LN unchanged), used there to warm-start 760M from 580M and
 
 Two layouts are supported because the models compile either way:
 - **stacked** (``scan_layers=True``): block params are [n_layers, ...] leaves
-  under ``blocks`` — extension is a ``jnp.repeat`` on axis 0;
+  under ``blocks`` — extension is an ``np.repeat`` on axis 0;
 - **per-block** (``scan_layers=False``): ``block_0`` … ``block_{N-1}``
   subtrees — extension copies subtrees.
 
@@ -19,8 +19,11 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+# numpy, not jax.numpy, for the array ops: surgery is a host-side tool
+# (export CLI, warm-start load path) and must never trigger accelerator
+# backend init. jax is imported for tree utilities only (host-side).
 import jax
-import jax.numpy as jnp
+import numpy as np
 
 _BLOCK_PREFIX = "block_"
 _STACKED_KEY = "blocks"
@@ -46,7 +49,7 @@ def stack_blocks(params: Dict[str, Any]) -> Dict[str, Any]:
         return params
     keys = _block_keys(params)
     blocks = [params[k] for k in keys]
-    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *blocks)
+    stacked = jax.tree.map(lambda *leaves: np.stack(leaves, axis=0), *blocks)
     out = {k: v for k, v in params.items() if not k.startswith(_BLOCK_PREFIX)}
     out[_STACKED_KEY] = stacked
     return out
@@ -90,7 +93,7 @@ def extend_depth(params: Dict[str, Any], n_new: int) -> Dict[str, Any]:
     if is_stacked(params):
         out = dict(params)
         out[_STACKED_KEY] = jax.tree.map(
-            lambda x: jnp.repeat(x, factor, axis=0), params[_STACKED_KEY]
+            lambda x: np.repeat(x, factor, axis=0), params[_STACKED_KEY]
         )
         return out
     out = {k: v for k, v in params.items() if not k.startswith(_BLOCK_PREFIX)}
@@ -99,4 +102,46 @@ def extend_depth(params: Dict[str, Any], n_new: int) -> Dict[str, Any]:
             out[f"{_BLOCK_PREFIX}{factor * i + j}"] = jax.tree.map(
                 lambda x: x, params[key]
             )
+    return out
+
+
+def upcycle_moe(
+    params: Dict[str, Any], n_experts: int, router_scale: float = 0.02
+) -> Dict[str, Any]:
+    """Sparse upcycling: dense checkpoint → MoE warm start.
+
+    Every block's dense MLP weights are replicated into all ``n_experts``
+    expert slots (each expert starts as an exact copy, so the upcycled model
+    computes the same function as the donor up to router mixing), and a
+    small random router is added. This is the Sparse Upcycling recipe
+    (Komatsuzaki et al. 2023) — the MoE analogue of the reference's
+    depth-extension warm start (reference ``extend_params.py``). Beyond the
+    reference, which has no MoE at all.
+
+    Expects/returns the stacked layout (``scan_layers=True``; convert with
+    ``stack_blocks`` first). The output matches ``Transformer`` with
+    ``n_experts=n_experts``: ``blocks/moe/{router, wi, wo[, gate]}``.
+    """
+    if not is_stacked(params):
+        raise ValueError("upcycle_moe expects the stacked layout (stack_blocks)")
+    if "mlp" not in params[_STACKED_KEY]:
+        raise ValueError("donor has no dense MLP to upcycle (already MoE?)")
+    blocks = dict(params[_STACKED_KEY])
+    mlp = blocks.pop("mlp")
+
+    # numpy throughout: surgery is a host-side tool (export CLI) and must
+    # not trigger accelerator backend init
+    def expertize(kernel):  # [L, d, f] -> [L, E, d, f]
+        return np.repeat(np.asarray(kernel)[:, None], n_experts, axis=1)
+
+    moe = {name: expertize(mlp[name]["kernel"]) for name in mlp}
+    wi = moe["wi"]
+    L, _, d, _ = wi.shape
+    rng = np.random.default_rng(0)
+    moe["router"] = (
+        rng.standard_normal((L, d, n_experts)).astype(np.float32) * router_scale
+    )
+    blocks["moe"] = moe
+    out = dict(params)
+    out[_STACKED_KEY] = blocks
     return out
