@@ -56,6 +56,31 @@ def test_roundtrip_preserves_values_and_shardings(setup, tmp_path):
     mgr.close()
 
 
+def test_roundtrip_moe_on_expert_pipe_mesh(devices, tmp_path):
+    """Sharded-native save/restore with MoE expert weights sharded over the
+    expert axis AND the layer stack sharded over the pipe axis — the exotic
+    layouts must round-trip like any other NamedSharding."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, vocab_size=128, n_experts=4, moe_top_k=2)
+    mesh = make_mesh(MeshConfig(pipe=2, data=2, expert=2), devices=devices)
+    model = Transformer(cfg)
+    tx = make_optimizer(OptimizerConfig(warmup_steps=5, total_steps=50))
+    plan = make_plan(model, tx, mesh, SHAPE, zero_stage=1)
+    state = init_train_state(model, tx, jax.random.PRNGKey(1), mesh, SHAPE, plan)
+    wi = state.params["blocks"]["moe"]["wi"]
+    assert "expert" in str(wi.sharding.spec) and "pipe" in str(wi.sharding.spec)
+
+    mgr = ckpt_lib.CheckpointManager(tmp_path / "ck", keep=1, async_save=False)
+    assert mgr.save(0, state, force=True)
+    mgr.wait()
+    restored, _ = mgr.restore(ckpt_lib.abstract_state(model, tx, plan, SHAPE))
+    tree_allclose(state, restored)
+    wi_r = restored.params["blocks"]["moe"]["wi"]
+    assert wi_r.sharding.is_equivalent_to(wi.sharding, wi.ndim)
+    mgr.close()
+
+
 def test_restore_params_only_warm_init(setup, tmp_path):
     mesh, model, tx, plan, state = setup
     mgr = ckpt_lib.CheckpointManager(tmp_path / "ck2", keep=1, async_save=False)

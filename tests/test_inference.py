@@ -120,6 +120,39 @@ def test_cached_decode_matches_full_forward(position):
         )
 
 
+def test_cached_decode_matches_full_forward_moe():
+    """KV-cache decode through MoE blocks: per-token routing (T=1, capacity
+    1) must reproduce the full forward exactly when the full forward drops
+    nothing — capacity_factor >= n_experts/top_k guarantees that (worst case
+    a single expert receives every token once)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, n_experts=4, moe_top_k=2, capacity_factor=2.0
+    )
+    full = Transformer(cfg)
+    dec = decode_model(cfg, cache_len=16)
+    B, T = 2, 10
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+    params = _params(full, B, T)
+
+    ref_logits = full.apply({"params": params}, x)
+
+    cache = init_cache(dec, B)
+    last, cache = prefill(dec, params, x[:, :4], cache)
+    np.testing.assert_allclose(last, ref_logits[:, 3], atol=1e-4, rtol=1e-4)
+    for t in range(4, T):
+        logits, vars_out = dec.apply(
+            {"params": params, "cache": cache}, x[:, t : t + 1], mutable=["cache"]
+        )
+        cache = vars_out["cache"]
+        np.testing.assert_allclose(
+            logits[:, 0], ref_logits[:, t], atol=1e-4, rtol=1e-4,
+            err_msg=f"position {t}",
+        )
+
+
 def test_generate_greedy_matches_manual_loop():
     model = decode_model(CFG, cache_len=24)
     full = Transformer(CFG)

@@ -1,0 +1,106 @@
+"""Optimizer families: adamw (reference parity), adafactor, lion.
+
+The reference hardcodes one AdamW chain (reference ``main_zero.py:160-168``);
+here the family is a config knob and each member must actually train on the
+8-device mesh with its optimizer state placed per the ZeRO plan.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import MeshConfig, ModelConfig, OptimizerConfig
+from zero_transformer_tpu.models import Transformer
+from zero_transformer_tpu.parallel import (
+    make_mesh,
+    make_plan,
+    init_train_state,
+    make_train_step,
+)
+from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
+
+CFG = ModelConfig(
+    name="t", vocab_size=256, d_model=64, n_heads=4, n_layers=2, max_seq_len=32,
+    dropout=0.0, compute_dtype="float32",
+)
+
+
+def _setup(opt_name, lr=1e-3):
+    opt = OptimizerConfig(
+        peak_learning_rate=lr, warmup_steps=4, total_steps=64, optimizer=opt_name
+    )
+    mesh = make_mesh(MeshConfig())
+    model = Transformer(CFG)
+    tx = make_optimizer(opt)
+    plan = make_plan(model, tx, mesh, (2, 16), 1)
+    state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, (2, 16), plan)
+    step = make_train_step(model, tx, mesh, plan, 1, make_schedule(opt))
+    return state, step
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, 256, (1, 8, 16)), jnp.int32)
+
+
+@pytest.mark.parametrize("opt_name,lr,drop", [
+    ("adamw", 1e-3, 0.5),
+    # adafactor scales updates by parameter norm (tiny at init on a tiny
+    # model), so it moves slower here; the contract is monotone learning,
+    # not a race
+    ("adafactor", 3e-2, 0.08),
+    ("lion", 3e-4, 0.5),
+])
+def test_all_families_train(devices, opt_name, lr, drop):
+    state, step = _setup(opt_name, lr)
+    rng = jax.random.PRNGKey(42)
+    losses = []
+    for _ in range(20):
+        state, metrics = step(state, _batch(), rng)
+        losses.append(float(metrics["loss"]))
+        assert np.isfinite(losses[-1])
+    best = min(losses)
+    assert best < losses[0] - drop, f"{opt_name}: no learning: {losses}"
+
+
+def test_adafactor_state_is_factored(devices):
+    """Adafactor's whole point: second-moment state much smaller than the
+    params (row/col factors instead of full mu+nu). optax only factors dims
+    >= 128, so this uses d_model=128 — at the default test width the
+    assertion would pass vacuously with nothing factored."""
+    big = dataclasses.replace(CFG, d_model=128, n_heads=4)
+    opt = OptimizerConfig(warmup_steps=4, total_steps=64, optimizer="adafactor")
+    mesh = make_mesh(MeshConfig())
+    model = Transformer(big)
+    tx = make_optimizer(opt)
+    plan = make_plan(model, tx, mesh, (2, 16), 1)
+    state_af = init_train_state(
+        model, tx, jax.random.PRNGKey(0), mesh, (2, 16), plan
+    )
+    n_params = sum(l.size for l in jax.tree.leaves(state_af.params))
+    af = sum(l.size for l in jax.tree.leaves(state_af.opt_state))
+    # factored: v_row+v_col (O(d+f)) instead of full v (O(d*f)) for the
+    # big kernels -> total opt state well under one params' worth
+    assert af < 0.6 * n_params, f"adafactor state {af} vs params {n_params}"
+
+
+def test_adafactor_rejected_at_zero2():
+    from zero_transformer_tpu.config import Config, TrainingConfig
+    from zero_transformer_tpu.training.trainer import Trainer
+
+    cfg = Config(
+        model=CFG,
+        mesh=MeshConfig(zero_stage=2),
+        optimizer=OptimizerConfig(warmup_steps=2, total_steps=8,
+                                  optimizer="adafactor"),
+        training=TrainingConfig(batch_size=8, train_context=16, total_steps=8),
+    )
+    with pytest.raises(ValueError, match="adafactor does not compose"):
+        Trainer(cfg)
+
+
+def test_invalid_family_rejected():
+    with pytest.raises(ValueError, match="invalid optimizer"):
+        OptimizerConfig(optimizer="sgd")

@@ -167,6 +167,14 @@ class OptimizerConfig:
     eps: float = 1e-8
     grad_clip: float = 1.0
     schedule: str = "warmup_cosine"  # "warmup_cosine" | "warmup_linear" | "constant"
+    # "adamw" (reference, main_zero.py:160-168) | "adafactor" (factored
+    # second moments — classic TPU memory saver for the largest models) |
+    # "lion" (momentum-only: one f32 buffer per param)
+    optimizer: str = "adamw"
+
+    def __post_init__(self):
+        if self.optimizer not in ("adamw", "adafactor", "lion"):
+            raise ValueError(f"invalid optimizer {self.optimizer!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,3 +1,4 @@
 from zero_transformer_tpu.evalharness.cli import main
 
-main()
+if __name__ == "__main__":
+    main()

@@ -125,8 +125,14 @@ def main(argv=None) -> None:
         for r in rows():
             choices = [_tok(tokenizer, c, True) for c in r["choices"]]
             byte_lens = r.get("choice_bytes")
-            if byte_lens is None and tokenizer is not None:
-                byte_lens = [len(str(c).encode()) for c in r["choices"]]
+            if byte_lens is None and all(
+                isinstance(c, str) for c in r["choices"]
+            ):
+                # lm-eval convention: UTF-8 length of the continuation as
+                # scored, including its leading space. Token-list choices
+                # without explicit byte lengths fall through to
+                # choice_accuracy's token-count normalization.
+                byte_lens = [len((" " + c).encode()) for c in r["choices"]]
             examples.append(
                 (_tok(tokenizer, r["context"]), choices, int(r["gold"]), byte_lens)
             )

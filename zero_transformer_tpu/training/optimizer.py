@@ -62,6 +62,35 @@ def weight_decay_mask(params: Any) -> Any:
     )
 
 
+def _lr_coupled_decay(
+    schedule, weight_decay: float
+) -> optax.GradientTransformation:
+    """AdamW-style decoupled weight decay (update -= lr·wd·p) appended AFTER
+    an optimizer whose own update doesn't include it. Needed for adafactor:
+    optax applies ``weight_decay_rate`` un-scaled by the learning rate, so a
+    0.1 AdamW-style value would shrink params 10% per step and collapse
+    training."""
+
+    def init(params):
+        del params
+        return optax.ScaleByScheduleState(count=jnp.zeros((), jnp.int32))
+
+    def update(updates, state, params):
+        if params is None:
+            raise ValueError("weight decay needs params")
+        lr = schedule(state.count)
+        mask = weight_decay_mask(params)
+        updates = jax.tree.map(
+            lambda u, p, m: u - lr * weight_decay * p if m else u,
+            updates,
+            params,
+            mask,
+        )
+        return updates, optax.ScaleByScheduleState(count=state.count + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
 def _clip_by_norm_fn(max_norm: float, norm_fn: Callable) -> optax.GradientTransformation:
     """``optax.clip_by_global_norm`` with a pluggable norm — needed inside a
     shard_map region, where ``optax.global_norm`` would see only this device's
@@ -89,23 +118,58 @@ def make_optimizer(
     schedule=None,
     global_norm_fn: Optional[Callable] = None,
 ) -> optax.GradientTransformation:
-    """AdamW chain. ``global_norm_fn`` swaps the grad-clip norm computation
-    (used by the explicit-collective ZeRO step, which runs the update on
-    gradient shards); state structure is unchanged either way."""
+    """Optimizer chain: clip → {adamw | adafactor | lion}.
+
+    ``global_norm_fn`` swaps the grad-clip norm computation (used by the
+    explicit-collective ZeRO step, which runs the update on gradient
+    shards); state structure is unchanged either way. Adafactor keeps
+    factored second moments (O(d+f) per [d,f] kernel instead of O(d·f)) —
+    the classic TPU choice when even ZeRO-sharded Adam moments don't fit;
+    lion keeps a single momentum buffer.
+
+    Adafactor does NOT compose with the explicit ZeRO-2/3 shard_map core:
+    its factored row/col statistics are replicated by the sharding plan
+    while gradients arrive reduce-scattered, which shape-errors at trace
+    time for any factored (>=128-dim) kernel. ``Trainer`` rejects the
+    combination up front; use stage <= 1 — adafactor's whole point is
+    removing the optimizer-memory pressure that higher stages exist to
+    shard.
+    """
     schedule = schedule or make_schedule(cfg)
     clip = (
         _clip_by_norm_fn(cfg.grad_clip, global_norm_fn)
         if global_norm_fn is not None
         else optax.clip_by_global_norm(cfg.grad_clip)
     )
-    return optax.chain(
-        clip,
-        optax.adamw(
+    if cfg.optimizer == "adafactor":
+        return optax.chain(
+            clip,
+            optax.adafactor(
+                learning_rate=schedule,
+                # external clip + schedule: disable adafactor's own update
+                # clipping so cfg.grad_clip is the single clipping knob
+                clipping_threshold=None,
+            ),
+            # decay OUTSIDE adafactor: optax's weight_decay_rate is applied
+            # un-scaled by lr (p -= wd*p per step would collapse training
+            # at AdamW-style wd=0.1)
+            _lr_coupled_decay(schedule, cfg.weight_decay),
+        )
+    if cfg.optimizer == "lion":
+        inner = optax.lion(
+            learning_rate=schedule,
+            b1=cfg.b1,
+            b2=cfg.b2,
+            weight_decay=cfg.weight_decay,
+            mask=weight_decay_mask,
+        )
+    else:
+        inner = optax.adamw(
             learning_rate=schedule,
             b1=cfg.b1,
             b2=cfg.b2,
             eps=cfg.eps,
             weight_decay=cfg.weight_decay,
             mask=weight_decay_mask,
-        ),
-    )
+        )
+    return optax.chain(clip, inner)

@@ -50,6 +50,15 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
         self.zero_stage = cfg.mesh.zero_stage
+        if cfg.optimizer.optimizer == "adafactor" and self.zero_stage >= 2:
+            # factored row/col stats are replicated by the sharding plan but
+            # the explicit ZeRO-2/3 core feeds the update gradient SHARDS —
+            # shape error deep in optax; fail with the real reason instead
+            raise ValueError(
+                "adafactor does not compose with ZeRO stage >= 2 (factored "
+                "stats vs sharded grads); use zero_stage <= 1 — adafactor "
+                "already removes the optimizer-memory pressure"
+            )
 
         opt = dataclasses.replace(cfg.optimizer, total_steps=cfg.training.total_steps)
         # an active sequence axis routes attention through the ring-attention
@@ -301,6 +310,9 @@ class Trainer:
                     util = monitoring.mfu(tok_s / n_chips, self.flops_per_token)
                     if util is not None:
                         payload["mfu"] = util
+                hbm = monitoring.hbm_used_gb()
+                if hbm is not None:
+                    payload["hbm_gb"] = hbm
                 self.metrics.log(payload, step, prefix="train")
                 tick_step = step
 

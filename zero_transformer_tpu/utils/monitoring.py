@@ -63,6 +63,19 @@ def mfu(
     return tokens_per_sec_per_chip * flops_per_token / peak
 
 
+def hbm_used_gb() -> Optional[float]:
+    """Device-0 HBM in use, GB (None where the backend exposes no stats —
+    CPU). The observability hook the reference never had: its OOMs were
+    discovered by crashing (reference ``logs/1B.md:7``)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    return stats["bytes_in_use"] / 1e9
+
+
 class MetricsLogger:
     """Console + JSONL + optional-wandb metrics sink."""
 
