@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# TPU VM bring-up: run once on every host of a pod slice.
+# Reference analogue: prepareTPUVM.sh (jax[tpu] install + deps).
+#
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --zone $ZONE --worker=all \
+#     --command="bash -s" < scripts/setup_tpu_vm.sh
+set -euo pipefail
+
+python3 -m pip install -U pip
+# TPU jax wheel rides libtpu from the special index
+python3 -m pip install -U "jax[tpu]" \
+  -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+# deps inlined (mirrors requirements.txt): under the piped invocation above
+# the repo is not on the remote host yet, so no file paths can be read
+python3 -m pip install flax optax orbax-checkpoint chex einops numpy pyyaml pytest
+# optional extras used when configured (wandb logging, gs:// data/ckpts,
+# HF-streaming source, tokenizer for serve/eval-on-text)
+python3 -m pip install wandb gcsfs datasets transformers || true
+
+python3 - <<'PY'
+import jax
+print(f"devices={jax.device_count()} local={jax.local_device_count()} "
+      f"process={jax.process_index()}/{jax.process_count()}")
+PY

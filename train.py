@@ -62,12 +62,21 @@ def main():
         metavar="N",
         help="capture a jax.profiler trace of N steps (after the compile step)",
     )
+    parser.add_argument(
+        "--debug-nans",
+        action="store_true",
+        default=False,
+        help="jax_debug_nans: fail fast at the op that produced a NaN "
+        "(numeric sanitizer; ~2x slower — debugging only)",
+    )
     # action="extend": repeated --set flags accumulate instead of the last
     # occurrence silently replacing earlier ones
     parser.add_argument(
         "--set", nargs="*", action="extend", default=None, metavar="KEY=VALUE"
     )
     args = parser.parse_args()
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
 
     logging.basicConfig(level=logging.INFO)
     from zero_transformer_tpu.config import load_config
