@@ -68,6 +68,12 @@ class ModelConfig:
     attention_impl: str = "auto"  # "auto" | "xla" | "flash" (pallas)
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    # Packed-sequence training: rows hold multiple documents separated by
+    # this token id. Attention is masked so documents cannot see each other
+    # (segments derived in-graph from the separator — no loader changes) and
+    # the loss never predicts across a boundary. None = rows are single
+    # documents (the reference's setup).
+    doc_sep_token: Optional[int] = None
     # Mixture-of-Experts (0 = dense MLP everywhere). With n_experts > 0 every
     # block's MLP becomes a top-k routed expert mixture with capacity-based
     # dispatch; expert weights shard over the mesh's `expert` axis (EP).
@@ -122,6 +128,12 @@ class ModelConfig:
             raise ValueError(f"invalid norm {self.norm!r}")
         if self.remat_policy not in ("none", "dots"):
             raise ValueError(f"invalid remat_policy {self.remat_policy!r}")
+        if self.doc_sep_token is not None and self.position == "learned":
+            raise ValueError(
+                "doc_sep_token packing requires a relative position scheme "
+                "(alibi/rope): learned absolute positions break the "
+                "packed==standalone logits contract"
+            )
         if self.n_experts < 0:
             raise ValueError("n_experts must be >= 0")
         if self.n_experts > 0 and self.moe_top_k not in (1, 2):

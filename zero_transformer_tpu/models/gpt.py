@@ -68,7 +68,7 @@ class Attention(nn.Module):
     mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, doc_ids: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         dtype = x.dtype
         param_dtype = resolve_dtype(cfg.param_dtype)
@@ -128,7 +128,8 @@ class Attention(nn.Module):
             )
         else:
             out = dot_product_attention(
-                q, k, v, causal=True, alibi=cfg.position == "alibi", impl=cfg.attention_impl
+                q, k, v, causal=True, alibi=cfg.position == "alibi",
+                doc_ids=doc_ids, impl=cfg.attention_impl,
             )
 
         out = out.reshape(B, T, H * D)
@@ -173,11 +174,19 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, carry, _=None):
         cfg = self.cfg
-        x, aux = carry
+        # packed-sequence models thread the document ids as a third carry
+        # element (constant through the layer scan); the decode path never
+        # packs, so its carry stays (x, aux)
+        packed = cfg.doc_sep_token is not None and not self.decode
+        if packed:
+            x, aux, doc_ids = carry
+        else:
+            x, aux = carry
+            doc_ids = None
         x = x + Attention(
             cfg, self.deterministic, self.decode, self.cache_len, self.mesh, name="attn"
         )(
-            _norm(cfg, x.dtype, "ln_attn")(x)
+            _norm(cfg, x.dtype, "ln_attn")(x), doc_ids
         )
         if cfg.n_experts > 0:
             from zero_transformer_tpu.models.moe import MoEMLP
@@ -191,7 +200,7 @@ class Block(nn.Module):
             x = x + MLP(cfg, self.deterministic, name="mlp")(
                 _norm(cfg, x.dtype, "ln_mlp")(x)
             )
-        return (x, aux), None
+        return ((x, aux, doc_ids) if packed else (x, aux)), None
 
 
 class Transformer(nn.Module):
@@ -270,6 +279,20 @@ class Transformer(nn.Module):
                 Block, prevent_cse=not cfg.scan_layers, policy=policy
             )
         aux = jnp.zeros((), jnp.float32)  # MoE router losses, summed over layers
+        packed = cfg.doc_sep_token is not None and not self.decode
+        doc_ids = None
+        if packed:
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "packed-sequence doc masking does not compose with "
+                    "sequence-parallel ring attention"
+                )
+            # the separator closes its own document (exclusive cumsum): the
+            # sep token attends within the doc it terminates, the token
+            # after it starts a fresh segment
+            is_sep = (x == cfg.doc_sep_token).astype(jnp.int32)
+            doc_ids = jnp.cumsum(is_sep, axis=1) - is_sep
+        carry = (h, aux, doc_ids) if packed else (h, aux)
         if cfg.scan_layers:
             stack = nn.scan(
                 block_cls,
@@ -278,13 +301,14 @@ class Transformer(nn.Module):
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, not train, self.decode, self.cache_len, self.mesh, name="blocks")
-            (h, aux), _ = stack((h, aux), None)
+            carry, _ = stack(carry, None)
         else:
             for i in range(cfg.n_layers):
-                (h, aux), _ = block_cls(
+                carry, _ = block_cls(
                     cfg, not train, self.decode, self.cache_len, self.mesh,
                     name=f"block_{i}",
-                )((h, aux), None)
+                )(carry, None)
+        h, aux = carry[0], carry[1]
 
         h = _norm(cfg, h.dtype, "ln_f")(h)
 
@@ -297,7 +321,16 @@ class Transformer(nn.Module):
 
         if labels is None:
             return logits
-        loss = next_token_loss(logits, labels)
+        if packed:
+            # never predict the first token of the NEXT document from the
+            # previous one: where the segment id changes, ignore the target
+            boundary = doc_ids[:, 1:] != doc_ids[:, :-1]
+            labels = jnp.concatenate(
+                [labels[:, :1], jnp.where(boundary, -1, labels[:, 1:])], axis=1
+            )
+            loss = next_token_loss(logits, labels, ignore_index=-1)
+        else:
+            loss = next_token_loss(logits, labels)
         if train and cfg.n_experts > 0:
             # router losses steer TRAINING only; eval loss stays pure CE so
             # perplexities remain comparable to dense models

@@ -28,6 +28,7 @@ def xla_attention(
     alibi: bool = False,
     q_offset=0,
     segment_ids: Optional[jax.Array] = None,
+    doc_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
 ) -> jax.Array:
     """Attention via explicit einsums, softmax in float32.
@@ -38,6 +39,9 @@ def xla_attention(
       q_offset: position of q[0] within the full sequence (decode w/ KV cache).
         May be a traced scalar.
       segment_ids: optional [B, Tkv] int mask; 0 = padding (masked out).
+      doc_ids: optional [B, T] int document ids (Tq == Tkv); positions in
+        DIFFERENT documents cannot attend to each other — the packed-sequence
+        training mask.
     """
     B, Tq, H, D = q.shape
     _, Tkv, KVH, _ = k.shape
@@ -59,6 +63,11 @@ def xla_attention(
     if segment_ids is not None:
         pad = jnp.where(segment_ids[:, None, None, None, :] != 0, 0.0, NEG_INF)
         scores = scores + pad
+    if doc_ids is not None:
+        if Tq != Tkv:
+            raise ValueError("doc_ids requires full-sequence shapes (Tq == Tkv)")
+        same = doc_ids[:, :, None] == doc_ids[:, None, :]  # [B, Tq, Tkv]
+        scores = scores + jnp.where(same, 0.0, NEG_INF)[:, None, None]
 
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", weights, v)
@@ -74,25 +83,37 @@ def dot_product_attention(
     alibi: bool = False,
     q_offset=0,
     segment_ids: Optional[jax.Array] = None,
+    doc_ids: Optional[jax.Array] = None,
     impl: str = "auto",
 ) -> jax.Array:
     """Dispatching attention entry point used by the models.
 
     impl="auto" picks the Pallas flash kernel on TPU for full-sequence causal
     training shapes and falls back to the XLA path everywhere else (decode,
-    CPU tests, odd shapes).
+    CPU tests, odd shapes, document-masked packing).
     """
     if impl in ("auto", "flash"):
         from zero_transformer_tpu.ops import flash_attention as fa
 
-        if fa.supported(
+        if doc_ids is None and fa.supported(
             q, k, v, causal=causal, alibi=alibi, q_offset=q_offset, segment_ids=segment_ids
         ):
             return fa.flash_attention(q, k, v, causal=causal, alibi=alibi)
         if impl == "flash":
+            # flash-or-raise contract: never silently hand an explicit
+            # flash request the O(T^2) fallback (doc masking included —
+            # the kernel has no doc-id plumbing)
             raise NotImplementedError(
-                f"flash attention unsupported for shapes q={q.shape} k={k.shape}"
+                f"flash attention unsupported for shapes q={q.shape} "
+                f"k={k.shape}" + (" with doc_ids" if doc_ids is not None else "")
             )
     return xla_attention(
-        q, k, v, causal=causal, alibi=alibi, q_offset=q_offset, segment_ids=segment_ids
+        q,
+        k,
+        v,
+        causal=causal,
+        alibi=alibi,
+        q_offset=q_offset,
+        segment_ids=segment_ids,
+        doc_ids=doc_ids,
     )

@@ -100,6 +100,11 @@ def make_pp_train_step(
         raise NotImplementedError(
             "pipeline parallelism supports alibi/rope positions"
         )
+    if cfg.doc_sep_token is not None:
+        raise NotImplementedError(
+            "packed-sequence doc masking is not plumbed through the pipeline "
+            "wavefront (its stage carry and head loss are unmasked)"
+        )
     l_local = cfg.n_layers // n_stages
     dtype = resolve_dtype(cfg.compute_dtype)
     param_dtype = resolve_dtype(cfg.param_dtype)
