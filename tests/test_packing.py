@@ -94,14 +94,59 @@ def test_packing_guards():
     # learned positions break the packed==standalone contract: rejected
     with pytest.raises(ValueError, match="relative position"):
         dataclasses.replace(CFG, position="learned", max_seq_len=32)
-    # explicit flash request with doc masking must raise, never silently
-    # fall back to the O(T^2) path
-    from zero_transformer_tpu.ops.attention import dot_product_attention
+    # out-of-vocab separator could never fire: rejected, not silently inert
+    with pytest.raises(ValueError, match="outside vocab"):
+        dataclasses.replace(CFG, doc_sep_token=50256)
+    # decode-shaped (Tq != S) doc masking is invalid
+    from zero_transformer_tpu.ops.pallas.flash import flash_attention
 
     q = jnp.zeros((1, 16, 4, 64))
-    ids = jnp.zeros((1, 16), jnp.int32)
-    with pytest.raises(NotImplementedError, match="doc_ids"):
-        dot_product_attention(q, q, q, doc_ids=ids, impl="flash")
+    k = jnp.zeros((1, 32, 4, 64))
+    with pytest.raises(ValueError, match="doc_ids"):
+        flash_attention(q, k, k, doc_ids=jnp.zeros((1, 16), jnp.int32))
+
+
+@pytest.mark.parametrize("alibi", [True, False])
+def test_flash_kernel_doc_mask_matches_xla(alibi):
+    """The Pallas kernel's doc masking (fwd AND grads) must match the XLA
+    reference exactly — this is what keeps packing viable at 8k+ context
+    where the XLA path OOMs."""
+    from zero_transformer_tpu.ops.attention import xla_attention
+    from zero_transformer_tpu.ops.pallas.flash import flash_attention
+
+    B, T, H, D = 2, 512, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+    # three documents per row, boundaries off block edges
+    ids = jnp.asarray(
+        np.concatenate([np.zeros(200), np.ones(190), np.full(122, 2)])[None]
+        .repeat(B, 0),
+        jnp.int32,
+    )
+    g = jax.random.normal(jax.random.PRNGKey(7), (B, T, H, D))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, alibi=alibi, doc_ids=ids, interpret=True
+            ) * g
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            xla_attention(q, k, v, causal=True, alibi=alibi, doc_ids=ids) * g
+        )
+
+    out_f = flash_attention(
+        q, k, v, causal=True, alibi=alibi, doc_ids=ids, interpret=True
+    )
+    out_x = xla_attention(q, k, v, causal=True, alibi=alibi, doc_ids=ids)
+    np.testing.assert_allclose(out_f, out_x, atol=2e-5, rtol=2e-5)
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gx = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gf, gx):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-3, err_msg=f"d{name}")
 
 
 def test_packed_training_decreases_loss(devices):

@@ -134,6 +134,14 @@ class ModelConfig:
                 "(alibi/rope): learned absolute positions break the "
                 "packed==standalone logits contract"
             )
+        if self.doc_sep_token is not None and not (
+            0 <= self.doc_sep_token < self.vocab_size
+        ):
+            raise ValueError(
+                f"doc_sep_token {self.doc_sep_token} outside vocab "
+                f"[0, {self.vocab_size}): the separator could never appear, "
+                "silently disabling document masking"
+            )
         if self.n_experts < 0:
             raise ValueError("n_experts must be >= 0")
         if self.n_experts > 0 and self.moe_top_k not in (1, 2):

@@ -95,17 +95,18 @@ def dot_product_attention(
     if impl in ("auto", "flash"):
         from zero_transformer_tpu.ops import flash_attention as fa
 
-        if doc_ids is None and fa.supported(
-            q, k, v, causal=causal, alibi=alibi, q_offset=q_offset, segment_ids=segment_ids
+        if fa.supported(
+            q, k, v, causal=causal, alibi=alibi, q_offset=q_offset,
+            segment_ids=segment_ids, doc_ids=doc_ids,
         ):
-            return fa.flash_attention(q, k, v, causal=causal, alibi=alibi)
+            return fa.flash_attention(
+                q, k, v, causal=causal, alibi=alibi, doc_ids=doc_ids
+            )
         if impl == "flash":
             # flash-or-raise contract: never silently hand an explicit
-            # flash request the O(T^2) fallback (doc masking included —
-            # the kernel has no doc-id plumbing)
+            # flash request the O(T^2) fallback
             raise NotImplementedError(
-                f"flash attention unsupported for shapes q={q.shape} "
-                f"k={k.shape}" + (" with doc_ids" if doc_ids is not None else "")
+                f"flash attention unsupported for shapes q={q.shape} k={k.shape}"
             )
     return xla_attention(
         q,

@@ -19,13 +19,18 @@ from zero_transformer_tpu.ops.pallas.flash import (
 )
 
 
-def supported(q, k, v, *, causal: bool, alibi: bool = False, q_offset=0, segment_ids=None) -> bool:
+def supported(
+    q, k, v, *, causal: bool, alibi: bool = False, q_offset=0,
+    segment_ids=None, doc_ids=None,
+) -> bool:
     # q_offset must be a static 0 (full-sequence training shapes): the kernel
     # has no offset plumbing, so a decode-style call must take the XLA path.
     if not (isinstance(q_offset, int) and q_offset == 0):
         return False
     if segment_ids is not None:
         return False
+    if doc_ids is not None and q.shape[1] != k.shape[1]:
+        return False  # document masking needs full self-attention shapes
     if jax.default_backend() != "tpu":
         return False
     B, T, H, D = q.shape
@@ -41,5 +46,7 @@ def supported(q, k, v, *, causal: bool, alibi: bool = False, q_offset=0, segment
     return True
 
 
-def flash_attention(q, k, v, *, causal: bool = True, alibi: bool = False) -> jax.Array:
-    return _pallas_flash(q, k, v, causal=causal, alibi=alibi)
+def flash_attention(
+    q, k, v, *, causal: bool = True, alibi: bool = False, doc_ids=None
+) -> jax.Array:
+    return _pallas_flash(q, k, v, causal=causal, alibi=alibi, doc_ids=doc_ids)

@@ -77,8 +77,13 @@ def _bias_block(slope, q_pos0, k_pos0, block_q: int, block_k: int, alibi, causal
     return bias
 
 
-def _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j):
-    """[block_q, block_k] f32 score block shared by all three kernels."""
+def _scores(
+    slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi, causal, docs, i, j
+):
+    """[block_q, block_k] f32 score block shared by all three kernels.
+
+    ``docs`` (static) adds the packed-sequence document mask: positions with
+    different ids (float32-encoded ints, exact ==) cannot attend."""
     q = q_ref[0, 0, :, :]
     k = k_ref[0, 0, :, :]
     s = jax.lax.dot_general(
@@ -86,9 +91,13 @@ def _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j):
     )
     q_pos0 = offs_ref[0, 0] + i * q.shape[0]
     k_pos0 = offs_ref[1, 0] + j * k.shape[0]
-    return s * scale + _bias_block(
+    s = s * scale + _bias_block(
         slope, q_pos0, k_pos0, q.shape[0], k.shape[0], alibi, causal
     )
+    if docs:
+        same = qid_ref[0, :][:, None] == kid_ref[0, :][None, :]
+        s = s + jnp.where(same, 0.0, NEG_INF).astype(jnp.float32)
+    return s
 
 
 def _run_predicate(offs_ref, i, j, block_q: int, block_k: int, causal: bool):
@@ -101,9 +110,9 @@ def _run_predicate(offs_ref, i, j, block_q: int, block_k: int, causal: bool):
 
 
 def _fwd_kernel(
-    slope_ref, offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    slope_ref, offs_ref, qid_ref, kid_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
-    *, scale: float, causal: bool, alibi: bool, n_k: int,
+    *, scale: float, causal: bool, alibi: bool, docs: bool, n_k: int,
 ):
     i, j = pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
@@ -117,7 +126,10 @@ def _fwd_kernel(
 
     @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
     def _compute():
-        s = _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j)
+        s = _scores(
+            slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi,
+            causal, docs, i, j,
+        )
         v = v_ref[0, 0, :, :]
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -140,9 +152,10 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    slope_ref, offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    slope_ref, offs_ref, qid_ref, kid_ref, q_ref, k_ref, v_ref, do_ref,
+    lse_ref, delta_ref, dq_ref,
     dq_scr,
-    *, scale: float, causal: bool, alibi: bool, n_k: int,
+    *, scale: float, causal: bool, alibi: bool, docs: bool, n_k: int,
 ):
     i, j = pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
@@ -154,7 +167,10 @@ def _dq_kernel(
 
     @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
     def _compute():
-        s = _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j)
+        s = _scores(
+            slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi,
+            causal, docs, i, j,
+        )
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
@@ -173,10 +189,11 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    slope_ref, offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    slope_ref, offs_ref, qid_ref, kid_ref, q_ref, k_ref, v_ref, do_ref,
+    lse_ref, delta_ref,
     dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale: float, causal: bool, alibi: bool, n_q: int,
+    *, scale: float, causal: bool, alibi: bool, docs: bool, n_q: int,
 ):
     # grid: (B, H, n_k, n_q) — j is the k-block, inner index i walks q-blocks
     j, i = pl.program_id(2), pl.program_id(3)
@@ -190,7 +207,10 @@ def _dkv_kernel(
 
     @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
     def _compute():
-        s = _scores(slope, offs_ref, q_ref, k_ref, scale, alibi, causal, i, j)
+        s = _scores(
+            slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi,
+            causal, docs, i, j,
+        )
         q = q_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
@@ -228,26 +248,49 @@ def _smem_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM if pltpu else None)
 
 
+def _ids_args(q_ids, k_ids, B, T, S):
+    """Always-present [B, T]/[B, S] f32 id arrays (zeros when unused — the
+    static ``docs`` flag keeps the disabled path free of mask compute)."""
+    qi = (
+        jnp.zeros((B, T), jnp.float32)
+        if q_ids is None
+        else q_ids.astype(jnp.float32)
+    )
+    ki = (
+        jnp.zeros((B, S), jnp.float32)
+        if k_ids is None
+        else k_ids.astype(jnp.float32)
+    )
+    return qi, ki
+
+
 def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
-         q_offset=0, kv_offset=0, slopes=None, out_dtype=None):
+         q_offset=0, kv_offset=0, slopes=None, out_dtype=None,
+         q_ids=None, k_ids=None):
     # [B, T, H, D] → [B, H, T, D]: Mosaic needs the blocked time axis in the
     # sublane position
+    docs = q_ids is not None
     q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     B, H, T, D = q.shape
     _, KVH, S, _ = k.shape
     G = H // KVH
     n_q, n_k = T // block_q, S // block_k
+    qi, ki = _ids_args(q_ids, k_ids, B, T, S)
 
     if slopes is None:
         slopes = _slopes_arg(H, alibi)
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
+    qid_spec = pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i))
+    kid_spec = pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j))
     o, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, alibi=alibi, n_k=n_k
+            _fwd_kernel, scale=scale, causal=causal, alibi=alibi, docs=docs,
+            n_k=n_k,
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[_smem_spec(), _smem_spec(), q_spec, kv_spec, kv_spec],
+        in_specs=[_smem_spec(), _smem_spec(), qid_spec, kid_spec,
+                  q_spec, kv_spec, kv_spec],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -262,17 +305,20 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, D), jnp.float32),  # acc
         ],
         interpret=interpret,
-    )(slopes, _offsets_arg(q_offset, kv_offset), q, k, v)
+    )(slopes, _offsets_arg(q_offset, kv_offset), qi, ki, q, k, v)
     return jnp.swapaxes(o, 1, 2), lse
 
 
 def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
-         q_offset=0, kv_offset=0, slopes=None, grad_dtype=None, delta=None):
+         q_offset=0, kv_offset=0, slopes=None, grad_dtype=None, delta=None,
+         q_ids=None, k_ids=None):
+    docs = q_ids is not None
     q, k, v, o, do = (jnp.swapaxes(x, 1, 2) for x in (q, k, v, o, do))
     B, H, T, D = q.shape
     _, KVH, S, _ = k.shape
     G = H // KVH
     n_q, n_k = T // block_q, S // block_k
+    qi, ki = _ids_args(q_ids, k_ids, B, T, S)
 
     if delta is None:  # rowsum(do * o) — loop-invariant for ring callers
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None]
@@ -284,18 +330,22 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
     kv_spec_iq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
     row_spec_iq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
+    qid_spec_iq = pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i))
+    kid_spec_iq = pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j))
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal, alibi=alibi, n_k=n_k
+            _dq_kernel, scale=scale, causal=causal, alibi=alibi, docs=docs,
+            n_k=n_k,
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[_smem_spec(), _smem_spec(), q_spec_iq, kv_spec_iq, kv_spec_iq,
+        in_specs=[_smem_spec(), _smem_spec(), qid_spec_iq, kid_spec_iq,
+                  q_spec_iq, kv_spec_iq, kv_spec_iq,
                   q_spec_iq, row_spec_iq, row_spec_iq],
         out_specs=q_spec_iq,
         out_shape=jax.ShapeDtypeStruct(q.shape, grad_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(slopes, offs, q, k, v, do, lse, delta)
+    )(slopes, offs, qi, ki, q, k, v, do, lse, delta)
 
     # k-block-major grid; q walked innermost. dk/dv computed per *query* head
     # ([B, H, S, D]) then group-summed to KVH for GQA.
@@ -303,12 +353,16 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
     kv_spec_jq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h // G, j, 0))
     kv_out_jq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
     row_spec_jq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0))
+    qid_spec_jq = pl.BlockSpec((1, block_q), lambda b, h, j, i: (b, i))
+    kid_spec_jq = pl.BlockSpec((1, block_k), lambda b, h, j, i: (b, j))
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, alibi=alibi, n_q=n_q
+            _dkv_kernel, scale=scale, causal=causal, alibi=alibi, docs=docs,
+            n_q=n_q,
         ),
         grid=(B, H, n_k, n_q),
-        in_specs=[_smem_spec(), _smem_spec(), q_spec_jq, kv_spec_jq, kv_spec_jq,
+        in_specs=[_smem_spec(), _smem_spec(), qid_spec_jq, kid_spec_jq,
+                  q_spec_jq, kv_spec_jq, kv_spec_jq,
                   q_spec_jq, row_spec_jq, row_spec_jq],
         out_specs=[kv_out_jq, kv_out_jq],
         out_shape=[
@@ -320,7 +374,7 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(slopes, offs, q, k, v, do, lse, delta)
+    )(slopes, offs, qi, ki, q, k, v, do, lse, delta)
 
     dq = jnp.swapaxes(dq, 1, 2)
     dk = jnp.swapaxes(dk, 1, 2)  # [B, S, H, D]
@@ -331,20 +385,29 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, doc_ids, causal, alibi, scale, block_q, block_k, interpret):
+    # doc_ids: [B, T] float32 (or None) — f32 so its zero cotangent below is
+    # a plain zeros_like rather than float0 plumbing
+    o, _ = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
+                q_ids=doc_ids, k_ids=doc_ids)
     return o
 
 
-def _flash_fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, doc_ids, causal, alibi, scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
+                  q_ids=doc_ids, k_ids=doc_ids)
+    return o, (q, k, v, doc_ids, o, lse)
 
 
 def _flash_bwd(causal, alibi, scale, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret)
+    q, k, v, doc_ids, o, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
+        q_ids=doc_ids, k_ids=doc_ids,
+    )
+    d_ids = None if doc_ids is None else jnp.zeros_like(doc_ids)
+    return dq, dk, dv, d_ids
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -368,20 +431,29 @@ def flash_attention(
     *,
     causal: bool = True,
     alibi: bool = False,
+    doc_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     block: Optional[int] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Differentiable flash attention. q [B,T,H,D]; k,v [B,S,KVH,D]."""
+    """Differentiable flash attention. q [B,T,H,D]; k,v [B,S,KVH,D].
+
+    ``doc_ids`` [B, T] int: packed-sequence document mask (requires T == S;
+    different ids cannot attend to each other)."""
     B, T, H, D = q.shape
     _, S, KVH, _ = k.shape
     if H % KVH:
         raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
+    if doc_ids is not None and T != S:
+        raise ValueError("doc_ids requires full-sequence shapes (T == S)")
     block_q, block_k = _resolve_blocks(T, S, block, block_q, block_k)
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
-    return _flash(q, k, v, causal, alibi, float(scale), block_q, block_k, interpret)
+    ids = None if doc_ids is None else doc_ids.astype(jnp.float32)
+    return _flash(
+        q, k, v, ids, causal, alibi, float(scale), block_q, block_k, interpret
+    )
 
 
 def flash_partial(
