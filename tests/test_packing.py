@@ -125,10 +125,14 @@ def test_flash_kernel_doc_mask_matches_xla(alibi):
     )
     g = jax.random.normal(jax.random.PRNGKey(7), (B, T, H, D))
 
+    # block=128 -> a 4x4 block grid with doc boundaries (200, 390) straddling
+    # block edges: exercises the online-softmax (m, l, acc) carry across
+    # fully- and partially-masked k-blocks, not just the single-block case
     def loss_flash(q, k, v):
         return jnp.sum(
             flash_attention(
-                q, k, v, causal=True, alibi=alibi, doc_ids=ids, interpret=True
+                q, k, v, causal=True, alibi=alibi, doc_ids=ids, block=128,
+                interpret=True,
             ) * g
         )
 
@@ -138,7 +142,7 @@ def test_flash_kernel_doc_mask_matches_xla(alibi):
         )
 
     out_f = flash_attention(
-        q, k, v, causal=True, alibi=alibi, doc_ids=ids, interpret=True
+        q, k, v, causal=True, alibi=alibi, doc_ids=ids, block=128, interpret=True
     )
     out_x = xla_attention(q, k, v, causal=True, alibi=alibi, doc_ids=ids)
     np.testing.assert_allclose(out_f, out_x, atol=2e-5, rtol=2e-5)

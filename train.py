@@ -9,6 +9,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import ast
 import dataclasses
 import logging
 
@@ -20,8 +21,6 @@ def parse_overrides(pairs):
     for pair in pairs or []:
         key, _, raw = pair.partition("=")
         try:
-            import ast
-
             out[key] = ast.literal_eval(raw)
         except (ValueError, SyntaxError):
             out[key] = raw

@@ -22,8 +22,8 @@ Pass ``--tokenizer <hf name/path>`` to instead accept text fields
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
-import sys
 from pathlib import Path
 
 import numpy as np
@@ -83,8 +83,6 @@ def main(argv=None) -> None:
         from transformers import AutoTokenizer
 
         tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
-
-    import itertools
 
     def rows():
         # bound the read AND the tokenization to what will be scored

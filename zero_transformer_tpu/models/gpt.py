@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.nn import initializers
 
 from zero_transformer_tpu.config import ModelConfig, resolve_dtype
+from zero_transformer_tpu.models.moe import MoEMLP
 from zero_transformer_tpu.ops.attention import dot_product_attention, xla_attention
 from zero_transformer_tpu.ops.losses import next_token_loss
 from zero_transformer_tpu.ops.positions import apply_rope
@@ -189,8 +190,6 @@ class Block(nn.Module):
             _norm(cfg, x.dtype, "ln_attn")(x), doc_ids
         )
         if cfg.n_experts > 0:
-            from zero_transformer_tpu.models.moe import MoEMLP
-
             mo, layer_aux = MoEMLP(cfg, self.deterministic, name="moe")(
                 _norm(cfg, x.dtype, "ln_mlp")(x)
             )

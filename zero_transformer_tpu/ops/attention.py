@@ -89,8 +89,8 @@ def dot_product_attention(
     """Dispatching attention entry point used by the models.
 
     impl="auto" picks the Pallas flash kernel on TPU for full-sequence causal
-    training shapes and falls back to the XLA path everywhere else (decode,
-    CPU tests, odd shapes, document-masked packing).
+    training shapes — including document-masked packing — and falls back to
+    the XLA path everywhere else (decode, CPU tests, odd shapes).
     """
     if impl in ("auto", "flash"):
         from zero_transformer_tpu.ops import flash_attention as fa

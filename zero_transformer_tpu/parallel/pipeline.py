@@ -35,19 +35,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from zero_transformer_tpu.config import resolve_dtype
 from zero_transformer_tpu.ops.losses import next_token_loss
 from zero_transformer_tpu.parallel.mesh import PIPE_AXIS
+from zero_transformer_tpu.parallel.sharding import restrict_spec
 
 
 def _pipe_part(spec: P) -> P:
     """Keep only the ``pipe`` entries of a spec (manual axis); every other
     axis stays auto under the partial-manual shard_map."""
-
-    def keep(e):
-        if e is None:
-            return None
-        names = set(e) if isinstance(e, tuple) else {e}
-        return e if names <= {PIPE_AXIS} else None
-
-    return P(*(keep(e) for e in spec))
+    return restrict_spec(spec, {PIPE_AXIS})
 
 
 def make_pp_train_step(

@@ -165,6 +165,25 @@ def opt_state_sharding(
     )
 
 
+def restrict_spec(spec: P, axes: set) -> P:
+    """Keep only the entries of ``spec`` whose axes are all in ``axes``;
+    everything else becomes None (auto/replicated).
+
+    Used by the partial-manual shard_map cores (ZeRO and pipeline): specs
+    handed to a partial-manual region may only mention its manual axes.
+    Entries name axes as bare strings or tuples (batch specs use
+    ``('data',)``), so comparison is by axis set.
+    """
+
+    def keep(e):
+        if e is None:
+            return None
+        names = set(e) if isinstance(e, tuple) else {e}
+        return e if names <= axes else None
+
+    return P(*(keep(e) for e in spec))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """[batch, seq] input sharding: batch over data(+fsdp), seq over sequence."""
     batch_axes = tuple(

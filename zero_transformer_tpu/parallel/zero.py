@@ -378,18 +378,9 @@ def _make_explicit_zero_step(
     zset = set(zaxes)
 
     def manual_part(spec: P) -> P:
-        """Keep only the ZeRO-axes entries of a spec: the tensor axis stays
-        auto (GSPMD) under the partial-manual shard_map, so specs handed to
-        it may not mention it. Entries name axes as bare strings or tuples
-        (batch specs use ``('data',)``); compare by axis set."""
-
-        def keep(e):
-            if e is None:
-                return None
-            names = set(e) if isinstance(e, tuple) else {e}
-            return e if names <= zset else None
-
-        return P(*(keep(e) for e in spec))
+        # tensor/expert axes stay auto (GSPMD) under the partial-manual
+        # shard_map; specs handed to it may only mention the ZeRO axes
+        return shd.restrict_spec(spec, zset)
 
     state_specs = TrainState(
         step=P(),
