@@ -125,7 +125,8 @@ class Attention(nn.Module):
             from zero_transformer_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(
-                q, k, v, self.mesh, causal=True, alibi=cfg.position == "alibi"
+                q, k, v, self.mesh, causal=True, alibi=cfg.position == "alibi",
+                doc_ids=doc_ids,
             )
         else:
             out = dot_product_attention(
@@ -281,14 +282,10 @@ class Transformer(nn.Module):
         packed = cfg.doc_sep_token is not None and not self.decode
         doc_ids = None
         if packed:
-            if self.mesh is not None:
-                raise NotImplementedError(
-                    "packed-sequence doc masking does not compose with "
-                    "sequence-parallel ring attention"
-                )
             # the separator closes its own document (exclusive cumsum): the
             # sep token attends within the doc it terminates, the token
-            # after it starts a fresh segment
+            # after it starts a fresh segment. Composes with ring attention
+            # too (the kv doc ids ride the ppermute ring).
             is_sep = (x == cfg.doc_sep_token).astype(jnp.int32)
             doc_ids = jnp.cumsum(is_sep, axis=1) - is_sep
         carry = (h, aux, doc_ids) if packed else (h, aux)

@@ -458,14 +458,17 @@ def flash_attention(
 
 def flash_partial(
     q, k, v, *, causal, alibi, softmax_scale, q_offset, kv_offset,
-    slopes=None, block: Optional[int] = None, interpret: bool = False,
+    slopes=None, q_ids=None, k_ids=None,
+    block: Optional[int] = None, interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Forward-only: (out [B,T,H,D], lse [B,H,T,1]) at global offsets.
 
     ``out`` is normalized by the LOCAL softmax sum; merge across kv shards
     with the lse (ring attention does this). ``slopes`` overrides the ALiBi
-    slope table for head-sharded (TP) calls. NOT differentiable — pair with
-    ``flash_grads`` under a custom VJP.
+    slope table for head-sharded (TP) calls; ``q_ids``/``k_ids`` are this
+    shard's document ids (ring packing — the kv ids rotate with the kv
+    shard). NOT differentiable — pair with ``flash_grads`` under a custom
+    VJP.
     """
     B, T, H, D = q.shape
     _, S, KVH, _ = k.shape
@@ -475,12 +478,14 @@ def flash_partial(
         q, k, v, causal, alibi, float(scale), block_q, block_k, interpret,
         q_offset=q_offset, kv_offset=kv_offset, slopes=slopes,
         out_dtype=jnp.float32,  # merged (and rounded once) by the caller
+        q_ids=q_ids, k_ids=k_ids,
     )
 
 
 def flash_grads(
     q, k, v, o, lse, do, *, causal, alibi, softmax_scale, q_offset, kv_offset,
-    slopes=None, delta=None, block: Optional[int] = None, interpret: bool = False,
+    slopes=None, delta=None, q_ids=None, k_ids=None,
+    block: Optional[int] = None, interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(dq, dk, dv) given the GLOBAL (out, lse) of the merged softmax —
     the flash backward identity p = exp(s - lse_global) makes per-shard
@@ -493,5 +498,5 @@ def flash_grads(
         q, k, v, o, lse, do, causal, alibi, float(scale), block_q, block_k,
         interpret, q_offset=q_offset, kv_offset=kv_offset, slopes=slopes,
         grad_dtype=jnp.float32,  # summed across ring steps by the caller
-        delta=delta,
+        delta=delta, q_ids=q_ids, k_ids=k_ids,
     )

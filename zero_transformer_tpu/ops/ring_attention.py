@@ -85,7 +85,7 @@ def _rotate(x, axis_name: str, n: int):
 # -- flash-backed ring (custom VJP) ------------------------------------------
 
 
-def _ring_flash_fwd_body(q, k, v, *, n, tp, H, causal, alibi, scale, interpret):
+def _ring_flash_fwd_body(q, k, v, ids, *, n, tp, H, causal, alibi, docs, scale, interpret):
     from zero_transformer_tpu.ops.pallas.flash import flash_partial
 
     B, t_q, H_l, D = q.shape
@@ -94,11 +94,12 @@ def _ring_flash_fwd_body(q, k, v, *, n, tp, H, causal, alibi, scale, interpret):
     t_kv = k.shape[1]
     slopes = _local_slopes(H, H_l, tp, alibi)
 
-    def fold(m, norm, acc, k_cur, v_cur, src):
+    def fold(m, norm, acc, k_cur, v_cur, kid_cur, src):
         o_i, lse_i = flash_partial(
             q, k_cur, v_cur,
             causal=causal, alibi=alibi, softmax_scale=scale,
             q_offset=q_off, kv_offset=src * t_kv, slopes=slopes,
+            q_ids=ids if docs else None, k_ids=kid_cur,
             interpret=interpret,
         )
         lse_i = lse_i[..., 0]  # [B, H_l, t_q]
@@ -111,29 +112,43 @@ def _ring_flash_fwd_body(q, k, v, *, n, tp, H, causal, alibi, scale, interpret):
         return m_new, norm_new, acc * wp + o_i * wi
 
     def step(carry, _):
-        m, norm, acc, k_cur, v_cur, src = carry
-        m, norm, acc = fold(m, norm, acc, k_cur, v_cur, src)
-        return (
+        # ids ride the scan carry (and the ppermute ring) ONLY when packing:
+        # the non-packed hot path pays zero extra collectives
+        if docs:
+            m, norm, acc, k_cur, v_cur, kid_cur, src = carry
+        else:
+            m, norm, acc, k_cur, v_cur, src = carry
+            kid_cur = None
+        m, norm, acc = fold(m, norm, acc, k_cur, v_cur, kid_cur, src)
+        out = (
             m, norm, acc,
             _rotate(k_cur, SEQUENCE_AXIS, n), _rotate(v_cur, SEQUENCE_AXIS, n),
-            (src - 1) % n,
-        ), None
+        )
+        if docs:
+            out += (_rotate(kid_cur, SEQUENCE_AXIS, n),)
+        return out + ((src - 1) % n,), None
 
     m0 = jnp.full((B, H_l, t_q), _INIT_M, jnp.float32)
     n0 = jnp.zeros((B, H_l, t_q), jnp.float32)
     a0 = jnp.zeros((B, t_q, H_l, D), jnp.float32)
+    init = (m0, n0, a0, k, v) + ((ids,) if docs else ()) + (my,)
     # n-1 rotated steps + a final fold without the (discarded) last rotation
-    (m, norm, acc, k_last, v_last, src), _ = jax.lax.scan(
-        step, (m0, n0, a0, k, v, my), None, length=n - 1
-    )
-    m, norm, acc = fold(m, norm, acc, k_last, v_last, src)
+    carry, _ = jax.lax.scan(step, init, None, length=n - 1)
+    if docs:
+        m, norm, acc, k_last, v_last, kid_last, src = carry
+    else:
+        m, norm, acc, k_last, v_last, src = carry
+        kid_last = None
+    m, norm, acc = fold(m, norm, acc, k_last, v_last, kid_last, src)
     norm_safe = jnp.where(norm == 0.0, 1.0, norm)
     out = acc / jnp.transpose(norm_safe, (0, 2, 1))[..., None]
     lse = (m + jnp.log(norm_safe))[..., None]  # [B, H_l, t_q, 1]
     return out.astype(q.dtype), lse
 
 
-def _ring_flash_bwd_body(q, k, v, o, lse, do, *, n, tp, H, causal, alibi, scale, interpret):
+def _ring_flash_bwd_body(
+    q, k, v, ids, o, lse, do, *, n, tp, H, causal, alibi, docs, scale, interpret
+):
     from zero_transformer_tpu.ops.pallas.flash import flash_grads
 
     B, t_q, H_l, D = q.shape
@@ -147,76 +162,104 @@ def _ring_flash_bwd_body(q, k, v, o, lse, do, *, n, tp, H, causal, alibi, scale,
         jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1), 1, 2
     )[..., None]
 
-    def grads_at(dq, dk_rot, dv_rot, k_cur, v_cur, src):
+    def grads_at(dq, dk_rot, dv_rot, k_cur, v_cur, kid_cur, src):
         dq_i, dk_i, dv_i = flash_grads(
             q, k_cur, v_cur, o, lse, do,
             causal=causal, alibi=alibi, softmax_scale=scale,
             q_offset=q_off, kv_offset=src * t_kv, slopes=slopes, delta=delta,
+            q_ids=ids if docs else None, k_ids=kid_cur,
             interpret=interpret,
         )
         return dq + dq_i, dk_rot + dk_i, dv_rot + dv_i
 
     def step(carry, _):
-        dq, dk_rot, dv_rot, k_cur, v_cur, src = carry
-        dq, dk_rot, dv_rot = grads_at(dq, dk_rot, dv_rot, k_cur, v_cur, src)
+        if docs:
+            dq, dk_rot, dv_rot, k_cur, v_cur, kid_cur, src = carry
+        else:
+            dq, dk_rot, dv_rot, k_cur, v_cur, src = carry
+            kid_cur = None
+        dq, dk_rot, dv_rot = grads_at(dq, dk_rot, dv_rot, k_cur, v_cur, kid_cur, src)
         # (dk, dv) accumulators ride the ring WITH their kv shard; after the
         # final rotation they land back on the shard's owner
-        return (
+        out = (
             dq,
             _rotate(dk_rot, SEQUENCE_AXIS, n), _rotate(dv_rot, SEQUENCE_AXIS, n),
             _rotate(k_cur, SEQUENCE_AXIS, n), _rotate(v_cur, SEQUENCE_AXIS, n),
-            (src - 1) % n,
-        ), None
+        )
+        if docs:
+            out += (_rotate(kid_cur, SEQUENCE_AXIS, n),)
+        return out + ((src - 1) % n,), None
 
     dq0 = jnp.zeros(q.shape, jnp.float32)
     dkv0 = jnp.zeros(k.shape, jnp.float32)
-    (dq, dk, dv, k_last, v_last, src), _ = jax.lax.scan(
-        step, (dq0, dkv0, dkv0, k, v, my), None, length=n - 1
-    )
+    init = (dq0, dkv0, dkv0, k, v) + ((ids,) if docs else ()) + (my,)
+    carry, _ = jax.lax.scan(step, init, None, length=n - 1)
+    if docs:
+        dq, dk, dv, k_last, v_last, kid_last, src = carry
+    else:
+        dq, dk, dv, k_last, v_last, src = carry
+        kid_last = None
     # final step: fold the last shard, then rotate ONLY the grad accumulators
     # (the kv rotation would be discarded)
-    dq, dk, dv = grads_at(dq, dk, dv, k_last, v_last, src)
+    dq, dk, dv = grads_at(dq, dk, dv, k_last, v_last, kid_last, src)
     dk = _rotate(dk, SEQUENCE_AXIS, n)
     dv = _rotate(dv, SEQUENCE_AXIS, n)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
-def _ring_flash(q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
+def _ring_flash(
+    q, k, v, ids, mesh, qkv_spec, lse_spec, ids_spec, n, tp, causal, alibi, scale, interpret
+):
     out, _ = _ring_flash_fwd(
-        q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret
+        q, k, v, ids, mesh, qkv_spec, lse_spec, ids_spec, n, tp, causal, alibi,
+        scale, interpret,
     )
     return out
 
 
-def _ring_flash_fwd(q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret):
+def _ring_flash_fwd(
+    q, k, v, ids, mesh, qkv_spec, lse_spec, ids_spec, n, tp, causal, alibi, scale, interpret
+):
     H = q.shape[2]
+    docs = ids is not None
+    if not docs:  # dummy rides the ring; the static flag skips mask compute
+        ids = jnp.zeros(q.shape[:2], jnp.float32)
     body = functools.partial(
         _ring_flash_fwd_body,
-        n=n, tp=tp, H=H, causal=causal, alibi=alibi, scale=scale, interpret=interpret,
+        n=n, tp=tp, H=H, causal=causal, alibi=alibi, docs=docs, scale=scale,
+        interpret=interpret,
     )
     out, lse = shard_map(
         body, mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, ids_spec),
         out_specs=(qkv_spec, lse_spec),
         check_vma=False,
-    )(q, k, v)
-    return out, (q, k, v, out, lse)
+    )(q, k, v, ids)
+    return out, (q, k, v, ids if docs else None, out, lse)
 
 
-def _ring_flash_bwd(mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret, res, do):
-    q, k, v, out, lse = res
+def _ring_flash_bwd(
+    mesh, qkv_spec, lse_spec, ids_spec, n, tp, causal, alibi, scale, interpret, res, do
+):
+    q, k, v, ids, out, lse = res
     H = q.shape[2]
+    docs = ids is not None
+    d_ids = None if ids is None else jnp.zeros_like(ids)
+    if not docs:
+        ids = jnp.zeros(q.shape[:2], jnp.float32)
     body = functools.partial(
         _ring_flash_bwd_body,
-        n=n, tp=tp, H=H, causal=causal, alibi=alibi, scale=scale, interpret=interpret,
+        n=n, tp=tp, H=H, causal=causal, alibi=alibi, docs=docs, scale=scale,
+        interpret=interpret,
     )
-    return shard_map(
+    dq, dk, dv = shard_map(
         body, mesh=mesh,
-        in_specs=(qkv_spec,) * 4 + (lse_spec, qkv_spec),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, ids_spec, qkv_spec, lse_spec, qkv_spec),
         out_specs=(qkv_spec, qkv_spec, qkv_spec),
         check_vma=False,
-    )(q, k, v, out, lse, do)
+    )(q, k, v, ids, out, lse, do)
+    return dq, dk, dv, d_ids
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -238,7 +281,7 @@ def _block_bias(slopes, q_off, kv_off, t_q: int, t_kv: int, causal: bool):
     return bias
 
 
-def _ring_xla_body(q, k, v, *, n, tp, H, causal, alibi, scale):
+def _ring_xla_body(q, k, v, ids, *, n, tp, H, causal, alibi, docs, scale):
     """Einsum inner engine: same merge math, full [t_q, t_kv] block per step
     (rematerialized in the backward via jax.checkpoint)."""
     B, t_q, H_l, D = q.shape
@@ -250,7 +293,7 @@ def _ring_xla_body(q, k, v, *, n, tp, H, causal, alibi, scale):
     slopes = _local_slopes(H, H_l, tp, alibi)[:, 0] if alibi else None
 
     @jax.checkpoint
-    def fold(m, l, acc, k_cur, v_cur, src):
+    def fold(m, l, acc, k_cur, v_cur, kid_cur, src):
         bias = _block_bias(slopes, q_off, src * t_kv, t_q, t_kv, causal)
         s = jnp.einsum(
             "btkgd,bskd->bkgts", qg, k_cur, preferred_element_type=jnp.float32
@@ -260,6 +303,9 @@ def _ring_xla_body(q, k, v, *, n, tp, H, causal, alibi, scale):
             s = s + bias[None, :, None]
         else:
             s = s + bias.reshape(1, KVH, G, t_q, t_kv)
+        if docs:
+            same = ids[:, :, None] == kid_cur[:, None, :]  # [B, t_q, t_kv]
+            s = s + jnp.where(same, 0.0, NEG_INF)[:, None, None]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
@@ -270,22 +316,32 @@ def _ring_xla_body(q, k, v, *, n, tp, H, causal, alibi, scale):
         return m_new, l_new, acc * alpha.transpose(0, 3, 1, 2)[..., None] + pv
 
     def step(carry, _):
-        m, l, acc, k_cur, v_cur, src = carry
-        m, l, acc = fold(m, l, acc, k_cur, v_cur, src)
-        return (
+        if docs:
+            m, l, acc, k_cur, v_cur, kid_cur, src = carry
+        else:
+            m, l, acc, k_cur, v_cur, src = carry
+            kid_cur = None
+        m, l, acc = fold(m, l, acc, k_cur, v_cur, kid_cur, src)
+        out = (
             m, l, acc,
             _rotate(k_cur, SEQUENCE_AXIS, n), _rotate(v_cur, SEQUENCE_AXIS, n),
-            (src - 1) % n,
-        ), None
+        )
+        if docs:
+            out += (_rotate(kid_cur, SEQUENCE_AXIS, n),)
+        return out + ((src - 1) % n,), None
 
     m0 = jnp.full((B, KVH, G, t_q), _INIT_M, jnp.float32)
     l0 = jnp.zeros((B, KVH, G, t_q), jnp.float32)
     a0 = jnp.zeros((B, t_q, KVH, G, D), jnp.float32)
+    init = (m0, l0, a0, k, v) + ((ids,) if docs else ()) + (my,)
     # n-1 rotated steps + a final fold without the (discarded) last rotation
-    (m, l, acc, k_last, v_last, src), _ = jax.lax.scan(
-        step, (m0, l0, a0, k, v, my), None, length=n - 1
-    )
-    m, l, acc = fold(m, l, acc, k_last, v_last, src)
+    carry, _ = jax.lax.scan(step, init, None, length=n - 1)
+    if docs:
+        m, l, acc, k_last, v_last, kid_last, src = carry
+    else:
+        m, l, acc, k_last, v_last, src = carry
+        kid_last = None
+    m, l, acc = fold(m, l, acc, k_last, v_last, kid_last, src)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = acc / l_safe.transpose(0, 3, 1, 2)[..., None]
     return out.reshape(B, t_q, H_l, D).astype(q.dtype)
@@ -316,6 +372,7 @@ def ring_attention(
     *,
     causal: bool = True,
     alibi: bool = False,
+    doc_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     impl: str = "auto",  # "auto" | "flash" | "xla"
     interpret: bool = False,  # run the Pallas engine interpreted (CPU tests)
@@ -325,6 +382,11 @@ def ring_attention(
     T must divide by the ``sequence`` axis size; heads by the ``tensor`` axis
     size when that is >1. With sequence=1 this degrades to a single local
     fold (still correct, but use the flash/XLA paths instead).
+
+    ``doc_ids`` [B, T] int: packed-sequence document mask — ids shard over
+    the sequence axis with q, and each device's kv ids ride the ppermute
+    ring with its kv shard, so cross-shard cross-document attention is
+    masked exactly.
     """
     B, T, H, D = q.shape
     _, S, KVH, _ = k.shape
@@ -340,6 +402,9 @@ def ring_attention(
         raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
     scale = float(softmax_scale if softmax_scale is not None else 1.0 / (D**0.5))
     qkv_spec, lse_spec = _specs(mesh, B, tp)
+    ids_spec = P(qkv_spec[0], SEQUENCE_AXIS)
+    docs = doc_ids is not None
+    ids = doc_ids.astype(jnp.float32) if docs else None
 
     use_flash = impl in ("auto", "flash") and _flash_local_ok(
         T // n, D, q.dtype, interpret
@@ -351,12 +416,17 @@ def ring_attention(
         )
     if use_flash:
         return _ring_flash(
-            q, k, v, mesh, qkv_spec, lse_spec, n, tp, causal, alibi, scale, interpret
+            q, k, v, ids, mesh, qkv_spec, lse_spec, ids_spec, n, tp, causal,
+            alibi, scale, interpret,
         )
 
     body = functools.partial(
-        _ring_xla_body, n=n, tp=tp, H=H, causal=causal, alibi=alibi, scale=scale
+        _ring_xla_body, n=n, tp=tp, H=H, causal=causal, alibi=alibi, docs=docs,
+        scale=scale,
     )
+    if not docs:
+        ids = jnp.zeros((B, T), jnp.float32)
     return shard_map(
-        body, mesh=mesh, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec, check_vma=False
-    )(q, k, v)
+        body, mesh=mesh, in_specs=(qkv_spec,) * 3 + (ids_spec,),
+        out_specs=qkv_spec, check_vma=False,
+    )(q, k, v, ids)
