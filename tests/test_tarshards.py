@@ -182,3 +182,68 @@ def test_make_source_tar_from_config(shard_dir):
     dl = make_loader(cfg, process_index=0, process_count=1)
     batch = next(iter(dl))
     assert batch.shape == (1, 4, 8)
+
+
+class TestErrorTolerance:
+    def _corrupt_setup(self, tmp_path):
+        """shard0: good row, corrupt .npy member, good row; shard1: good."""
+        good = np.full(8, 7, np.int32)
+        p0 = str(tmp_path / "bad-000.tar")
+        with tarfile.open(p0, "w") as tar:
+            for name, data in [
+                ("00000.npy", _npy_bytes(good)),
+                ("00001.npy", b"\x00not-a-npy-file"),
+                ("00002.npy", _npy_bytes(good + 1)),
+            ]:
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        p1 = write_shard(tmp_path / "bad-001.tar", [np.full(8, 9, np.int32)])
+        return [p0, p1]
+
+    def test_corrupt_member_skipped_by_default(self, tmp_path):
+        shards = self._corrupt_setup(tmp_path)
+        src = TarShardSource(shards, max_context=8, shuffle_shards=False)
+        rows = take(iter(src), 3)
+        np.testing.assert_array_equal(rows[0], np.full(8, 7))
+        np.testing.assert_array_equal(rows[1], np.full(8, 8))  # after the bad one
+        np.testing.assert_array_equal(rows[2], np.full(8, 9))
+
+    def test_strict_raises_on_corrupt_member(self, tmp_path):
+        shards = self._corrupt_setup(tmp_path)
+        src = TarShardSource(
+            shards, max_context=8, shuffle_shards=False, strict=True
+        )
+        it = iter(src)
+        take(it, 1)
+        with pytest.raises(Exception):
+            take(it, 1)
+
+    def test_all_shards_dead_raises_not_spins(self, tmp_path):
+        # a fully unreadable shard list must raise after one epoch pass,
+        # never busy-loop warnings forever
+        bad = tmp_path / "nope-000.tar.gz"
+        bad.write_bytes(b"not a tar at all")
+        src = TarShardSource([str(bad)], max_context=8, shuffle_shards=False)
+        with pytest.raises(RuntimeError, match="zero rows"):
+            take(iter(src), 1)
+
+    def test_truncated_gzip_shard_skipped(self, tmp_path):
+        good = write_shard(tmp_path / "g-000.tar.gz",
+                           [np.full(8, 1, np.int32)], gz=True)
+        bad_path = tmp_path / "g-001.tar.gz"
+        data = open(good, "rb").read()
+        bad_path.write_bytes(data[: len(data) // 2])  # truncated stream
+        tail = write_shard(tmp_path / "g-002.tar.gz",
+                           [np.full(8, 3, np.int32)], gz=True)
+        src = TarShardSource([good, str(bad_path), tail], max_context=8,
+                             shuffle_shards=False)
+        rows = take(iter(src), 2)
+        np.testing.assert_array_equal(rows[0], np.full(8, 1))
+        np.testing.assert_array_equal(rows[1], np.full(8, 3))
+
+
+def _npy_bytes(row):
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(row))
+    return buf.getvalue()

@@ -141,6 +141,31 @@ def test_warm_init_msgpack_upcycles_dense_to_moe(tmp_path, devices, scan_layers)
     trainer.close()
 
 
+def test_halt_on_nan_saves_and_raises(tmp_path, devices):
+    """A non-finite loss must checkpoint-and-stop, not burn further steps
+    (checked at log sync points — no extra device syncs)."""
+    import jax.numpy as jnp
+
+    cfg = tiny_config(tmp_path, total_steps=20)
+    trainer = Trainer(cfg)
+    trainer.init_state()
+    real_step = trainer.train_step
+
+    def poisoned(state, batch, rng):
+        state, metrics = real_step(state, batch, rng)
+        metrics = dict(metrics)
+        metrics["loss"] = jnp.float32(jnp.nan)
+        return state, metrics
+
+    trainer.train_step = poisoned
+    with pytest.raises(RuntimeError, match="non-finite loss"):
+        trainer.train()
+    # the poisoned state must NOT bury the last good checkpoint: nothing is
+    # saved at the NaN step (here: no checkpoint at all yet)
+    assert trainer.ckpt.latest_step() is None
+    trainer.close()
+
+
 def test_evaluate_window_pinned(tmp_path, devices):
     # two consecutive evaluates on an unchanged model must score the SAME
     # data window (round-2 verdict: each eval consumed the next N batches of

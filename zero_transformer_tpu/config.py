@@ -211,6 +211,10 @@ class TrainingConfig:
     # starting after the first (compile) step; viewable in TensorBoard/XProf
     profile_steps: int = 0
     profile_dir: str = ""  # default: <checkpoint.directory>/profile
+    # stop (after force-saving a checkpoint) when the loss goes NaN/inf —
+    # checked at each log sync point, so it costs nothing extra. The
+    # reference could burn days of pod time past a divergence.
+    halt_on_nan: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -228,6 +232,9 @@ class DataConfig:
     # (DataLoader.prefetch); 0 = fully synchronous. The reference used torch
     # DataLoader workers for the same overlap (main_zero.py:407-421).
     num_workers: int = 2
+    # tar source: True crashes on any undecodable member / unreadable shard
+    # (data validation); False warns, retries opens once, and skips
+    strict: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

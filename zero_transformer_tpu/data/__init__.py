@@ -47,6 +47,7 @@ def make_source(
             max_context=data.max_context,
             seed=data.shuffle_seed,
             shuffle_shards=not validation,
+            strict=data.strict,
             process_index=(
                 process_index if process_index is not None else jax.process_index()
             ),

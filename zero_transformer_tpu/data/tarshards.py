@@ -31,6 +31,7 @@ from __future__ import annotations
 import gzip
 import io
 import json
+import logging
 import re
 import tarfile
 from pathlib import Path
@@ -39,6 +40,8 @@ from typing import Iterator, List, Sequence
 import numpy as np
 
 from zero_transformer_tpu.data.sources import ReplayStreamSource
+
+log = logging.getLogger("zero_transformer_tpu")
 
 _BRACE = re.compile(r"\{(\d+)\.\.(\d+)\}")
 
@@ -108,6 +111,16 @@ class TarShardSource(ReplayStreamSource):
       stripe_shards: "auto" stripes at shard granularity when every process
         can own >= 2 shards (per-host IO then scales 1/P instead of every
         host decompressing every shard); True forces it, False disables.
+      strict: False (default) logs and skips undecodable members / unreadable
+        shards instead of crashing a multi-day run on one bad byte — the
+        reference's ``wds.warn_and_continue`` semantics (reference
+        ``main_zero.py:392-394``); shard-open failures get one retry so a
+        transient remote-IO blip doesn't edit the stream. True re-raises
+        immediately (tests, data validation). CAVEAT: skipping is only
+        DETERMINISTIC for persistent corruption; if flaky remote IO skips a
+        shard on one host (or on the original pass but not a resume replay),
+        row striping / resume positions shift — prefer strict=True when the
+        storage layer is suspect.
 
     Resume: ``seek``/``restore`` replay the stream and discard
     (``ReplayStreamSource``) — the reference's islice fast-forward
@@ -124,6 +137,7 @@ class TarShardSource(ReplayStreamSource):
         process_index: int = 0,
         process_count: int = 1,
         stripe_shards: bool | str = "auto",
+        strict: bool = False,
     ):
         if isinstance(shards, (str, Path)):
             shards = [str(shards)]
@@ -153,6 +167,7 @@ class TarShardSource(ReplayStreamSource):
         # pre_striped tells the DataLoader this source already yields only
         # this process's rows, so its row striping must be skipped.
         self.pre_striped = bool(stripe_shards) and process_count > 1
+        self.strict = strict
         super().__init__()
 
     def _shard_order(self, epoch: int) -> List[str]:
@@ -167,22 +182,68 @@ class TarShardSource(ReplayStreamSource):
             order = order[self.process_index :: self.process_count]
         return order
 
+    def _shard_rows(self, shard: str) -> Iterator[np.ndarray]:
+        with _open_shard(shard) as raw, tarfile.open(fileobj=raw, mode="r|") as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                try:
+                    data = tar.extractfile(member).read()
+                    ids = _decode_member(member.name, data)
+                except Exception:
+                    if self.strict:
+                        raise
+                    log.warning(
+                        "skipping undecodable member %s in %s",
+                        member.name, shard, exc_info=True,
+                    )
+                    continue
+                if ids is None:
+                    continue
+                ids = np.asarray(ids).reshape(-1)
+                if len(ids) < self.max_context:
+                    continue
+                yield ids[: self.max_context].astype(np.int32)
+
     def _samples(self) -> Iterator[np.ndarray]:
         epoch = 0
         while True:
+            yielded = 0
             for shard in self._shard_order(epoch):
-                with _open_shard(shard) as raw, tarfile.open(
-                    fileobj=raw, mode="r|"
-                ) as tar:
-                    for member in tar:
-                        if not member.isfile():
+                # retries before skipping: a transient remote-IO blip must
+                # not edit the stream (a skipped shard shifts every later
+                # row position — see the strict docstring caveat). A shard
+                # that fails AFTER yielding rows cannot be retried (the
+                # already-yielded prefix would duplicate) — its remainder is
+                # skipped.
+                for attempt in range(3):
+                    from_this_shard = 0
+                    try:
+                        for row in self._shard_rows(shard):
+                            from_this_shard += 1
+                            yielded += 1
+                            yield row
+                        break
+                    except Exception:
+                        if self.strict:
+                            raise
+                        if attempt < 2 and from_this_shard == 0:
+                            log.warning(
+                                "retrying shard %s (attempt %d)", shard, attempt + 2
+                            )
                             continue
-                        data = tar.extractfile(member).read()
-                        ids = _decode_member(member.name, data)
-                        if ids is None:
-                            continue
-                        ids = np.asarray(ids).reshape(-1)
-                        if len(ids) < self.max_context:
-                            continue
-                        yield ids[: self.max_context].astype(np.int32)
+                        log.warning(
+                            "skipping %s of shard %s",
+                            "remainder" if from_this_shard else "all",
+                            shard, exc_info=True,
+                        )
+                        break
+            if yielded == 0:
+                # every shard failed or filtered to nothing: raising beats a
+                # silent infinite busy-loop of warnings
+                raise RuntimeError(
+                    f"tar source produced zero rows in one full epoch over "
+                    f"{len(self.shards)} shard(s) — bad paths, corrupt data, "
+                    f"or all rows shorter than max_context={self.max_context}"
+                )
             epoch += 1

@@ -293,6 +293,21 @@ class Trainer:
 
             if step % cfg.log_frequency == 0 or step == end:
                 loss = float(metrics["loss"])  # device sync point
+                if cfg.halt_on_nan and not jnp.isfinite(loss):
+                    # deliberately NOT saving: this state is post-divergence
+                    # (NaN already written into params/opt by the update);
+                    # saving it would bury the last GOOD checkpoint that
+                    # --resume restores from
+                    if profiling:
+                        jax.profiler.stop_trace()
+                    restore_handler()
+                    good = self.ckpt.latest_step()
+                    raise RuntimeError(
+                        f"non-finite loss {loss} at step {step}; NOT "
+                        f"checkpointed (state is already poisoned) — resume "
+                        f"from step {good} and rerun with --debug-nans to "
+                        f"find the source op"
+                    )
                 dt = timer.tick()
                 payload = {
                     "loss": loss,
