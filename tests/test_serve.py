@@ -68,3 +68,60 @@ def test_prompt_longer_than_budget_keeps_tail(generator):
 def test_no_room_for_prompt_raises(generator):
     with pytest.raises(ValueError, match="no room"):
         generator("hi", max_new_tokens=32)
+
+
+def test_stream_matches_one_shot_greedy(generator):
+    full = generator("stream me", max_new_tokens=8, greedy=True)
+    streamed = "".join(
+        generator.stream("stream me", max_new_tokens=8, greedy=True)
+    )
+    assert streamed == full
+
+
+def test_stream_holds_back_incomplete_multibyte_chars():
+    """Byte-level BPE: a character spanning 2 tokens decodes to U+FFFD until
+    complete — the stream must hold it back, never emit the replacement char
+    mid-stream, and still concatenate to the full decode."""
+    model = Transformer(CFG)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    class PairTok:
+        eos_token_id = None  # never stop early
+
+        def encode(self, text):
+            return [ord(c) % 60 + 1 for c in text]
+
+        def decode(self, ids):
+            # every 2 tokens form one char; a dangling token is incomplete
+            full = "".join(chr(97 + (a % 26)) for a in ids[::2][: len(ids) // 2])
+            return full + ("�" if len(ids) % 2 else "")
+
+    gen = TextGenerator(CFG, params, PairTok(), cache_len=32)
+    pieces = list(gen.stream("seed", max_new_tokens=7, greedy=True))
+    assert all("�" not in p for p in pieces[:-1])
+    # concatenation equals the full decode of everything emitted (7 tokens:
+    # 3 complete chars + one genuine trailing replacement char flushed at
+    # stream end)
+    full = "".join(pieces)
+    assert full.count("�") == 1 and full.endswith("�")
+    assert len(full) == 4  # 3 complete chars + held-back flush
+
+
+def test_stream_tokens_matches_generate_greedy():
+    """The streaming per-step path must sample the same greedy trajectory as
+    the fused while_loop generate."""
+    from zero_transformer_tpu.inference import (
+        SamplingConfig, decode_model, generate, stream_tokens,
+    )
+
+    model = Transformer(CFG)
+    dec = decode_model(CFG, cache_len=32)
+    prompt = jnp.asarray([[5, 9, 11]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    cfg = SamplingConfig(greedy=True)
+    rng = jax.random.PRNGKey(1)
+    want = generate(dec, params, prompt, 8, rng, cfg)
+    got = [int(t[0]) for t in stream_tokens(dec, params, prompt, 8, rng, cfg)]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want[0]))

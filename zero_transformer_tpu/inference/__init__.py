@@ -7,6 +7,7 @@ from zero_transformer_tpu.inference.generate import (
     generate_tokens,
     init_cache,
     prefill,
+    stream_tokens,
 )
 from zero_transformer_tpu.inference.sampling import (
     SamplingConfig,
@@ -27,6 +28,7 @@ __all__ = [
     "prefill",
     "process_logits",
     "sample_token",
+    "stream_tokens",
     "top_k_filter",
     "top_p_filter",
 ]

@@ -165,6 +165,70 @@ def _decode_loop(
     return out
 
 
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def _stream_sample(sampling, rng, logits, gen_mask):
+    token = sample_token(rng, logits, sampling, gen_mask)
+    newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
+    return token, gen_mask | newly
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def _stream_forward(model, params, token, cache):
+    # cache donated: in-place HBM update per token, like the fused loop
+    next_logits, vars_out = model.apply(
+        {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
+    )
+    return next_logits[:, -1, :].astype(jnp.float32), vars_out["cache"]
+
+
+def stream_tokens(
+    model: Transformer,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    rng: jax.Array,
+    sampling: SamplingConfig = SamplingConfig(),
+    eos_token_id: Optional[int] = None,
+):
+    """Yield tokens one step at a time (a [B] int32 array per yield).
+
+    The per-token host round trip the reference's UI loop paid for every
+    request (reference ``app.py:69-94``) — here an explicit OPT-IN for
+    interactive streaming; use ``generate`` (single compiled while_loop) for
+    throughput. Each step is a jitted sample + a jitted cached forward (the
+    FINAL token's forward is skipped, matching ``generate``); rows that hit
+    ``eos_token_id`` stop the stream when ALL rows are done (callers doing
+    single-row streaming just break on their own EOS).
+    """
+    cache_len = model.cache_len or model.cfg.max_seq_len
+    B, T = prompt.shape
+    if T + max_new_tokens - 1 > cache_len:
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache_len ({cache_len})"
+        )
+    if model.cfg.position == "learned" and T + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({model.cfg.max_seq_len}) and learned positions "
+            "cannot extrapolate (use position='alibi' or 'rope')"
+        )
+    cache = init_cache(model, B)
+    logits, cache = prefill(model, params, prompt, cache)
+    gen_mask = jnp.zeros((B, logits.shape[-1]), jnp.bool_)
+    done = jnp.zeros((B,), jnp.bool_)
+    for step in range(max_new_tokens):
+        rng, sub = jax.random.split(rng)
+        token, gen_mask = _stream_sample(sampling, sub, logits, gen_mask)
+        yield token
+        if eos_token_id is not None:
+            done = done | (token == eos_token_id)
+            if bool(jnp.all(done)):
+                return
+        if step + 1 < max_new_tokens:  # the last token is never fed back
+            logits, cache = _stream_forward(model, params, token, cache)
+
+
 def generate_tokens(
     cfg: ModelConfig,
     params: Any,

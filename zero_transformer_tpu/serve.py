@@ -58,21 +58,12 @@ class TextGenerator:
         greedy: bool = False,
         seed: int = 0,
     ) -> str:
-        from zero_transformer_tpu.inference import SamplingConfig, generate
+        from zero_transformer_tpu.inference import generate
 
-        ids = self.tokenizer.encode(prompt.strip())
-        budget = self.cache_len - max_new_tokens
-        if budget < 1:
-            raise ValueError("max_new_tokens leaves no room for the prompt")
-        ids = ids[-budget:]  # keep the tail (reference app.py:61-64)
-        sampling = SamplingConfig(
-            temperature=temperature,
-            top_k=top_k,
-            top_p=top_p,
-            repetition_penalty=repetition_penalty,
-            greedy=greedy,
+        ids, sampling, eos = self._prepare(
+            prompt, max_new_tokens, temperature, top_k, top_p,
+            repetition_penalty, greedy,
         )
-        eos = self.tokenizer.eos_token_id
         out = generate(
             self.model,
             self.params,
@@ -87,6 +78,69 @@ class TextGenerator:
         )
         toks = [t for t in out[0].tolist() if t != eos]
         return self.tokenizer.decode(toks)
+
+    def _prepare(
+        self, prompt, max_new_tokens, temperature, top_k, top_p,
+        repetition_penalty, greedy,
+    ):
+        """Shared encode/truncate/sampling preamble for __call__ and stream
+        (one source of truth: the two paths must never diverge)."""
+        from zero_transformer_tpu.inference import SamplingConfig
+
+        ids = self.tokenizer.encode(prompt.strip())
+        budget = self.cache_len - max_new_tokens
+        if budget < 1:
+            raise ValueError("max_new_tokens leaves no room for the prompt")
+        ids = ids[-budget:]  # keep the tail (reference app.py:61-64)
+        sampling = SamplingConfig(
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            repetition_penalty=repetition_penalty, greedy=greedy,
+        )
+        return ids, sampling, self.tokenizer.eos_token_id
+
+    def stream(
+        self,
+        prompt: str,
+        max_new_tokens: int = 128,
+        temperature: float = 0.8,
+        top_k: int = 0,
+        top_p: float = 0.9,
+        repetition_penalty: float = 1.1,
+        greedy: bool = False,
+        seed: int = 0,
+    ):
+        """Yield decoded text increments as tokens generate (the reference
+        UI's streaming behavior, ``app.py:42-94``, on the jitted step)."""
+        from zero_transformer_tpu.inference import stream_tokens
+
+        ids, sampling, eos = self._prepare(
+            prompt, max_new_tokens, temperature, top_k, top_p,
+            repetition_penalty, greedy,
+        )
+        emitted: list = []
+        shown = 0
+        for token in stream_tokens(
+            self.model, self.params, jnp.asarray([ids], jnp.int32),
+            max_new_tokens, jax.random.PRNGKey(seed), sampling,
+            eos_token_id=eos,
+        ):
+            t = int(token[0])
+            if eos is not None and t == eos:
+                break
+            emitted.append(t)
+            # decode the whole tail each time so multi-token characters
+            # (byte-level BPE) render correctly; hold output back while the
+            # tail is an incomplete byte sequence (decodes to U+FFFD)
+            text = self.tokenizer.decode(emitted)
+            if text.endswith("�"):
+                continue
+            if len(text) > shown:
+                yield text[shown:]
+                shown = len(text)
+        # flush anything held back at stream end (genuine replacement chars)
+        text = self.tokenizer.decode(emitted)
+        if len(text) > shown:
+            yield text[shown:]
 
 
 def _build_generator(args) -> TextGenerator:
@@ -109,17 +163,18 @@ def _repl(gen: TextGenerator, args) -> None:
             return
         if not prompt.strip():
             return
-        print(
-            gen(
-                prompt,
-                max_new_tokens=args.max_new_tokens,
-                temperature=args.temperature,
-                top_k=args.top_k,
-                top_p=args.top_p,
-                repetition_penalty=args.repetition_penalty,
-                greedy=args.greedy,
-            )
-        )
+        # stream tokens as they decode (reference app.py behavior)
+        for piece in gen.stream(
+            prompt,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            repetition_penalty=args.repetition_penalty,
+            greedy=args.greedy,
+        ):
+            print(piece, end="", flush=True)
+        print()
 
 
 def _ui(gen: TextGenerator) -> None:
