@@ -381,12 +381,21 @@ def main() -> None:
         )
         if not res.get("ok"):
             errors.append(_truncate(f"cpu: {res.get('error')}"))
+        # the loader path is host-side: measurable even with the TPU down
+        loader = _run_child("loader", {"BENCH_PLATFORM": "cpu"}, 300.0)
+        if not loader.get("ok"):
+            errors.append(_truncate(f"loader: {loader.get('error')}"))
         out = {
             "metric": "train_tokens_per_sec_per_chip_cpu_fallback",
             "value": res.get("tok_s_chip", 0.0),
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,  # no TPU datapoint: honest zero, see errors
-            "extra": {"scenarios": results, "cpu_fallback": res, "errors": errors},
+            "extra": {
+                "scenarios": results,
+                "cpu_fallback": res,
+                "loader_microbench": loader,
+                "errors": errors,
+            },
         }
 
     # Artifact contract: exactly one JSON line, parseable, bounded size.

@@ -75,6 +75,26 @@ def generate(
     padded with ``pad_token_id`` afterwards; the loop exits early once every
     row is done (the reference's EOS handling, ``app.py:79-92``, single-row).
     """
+    last_logits, cache, gen_mask = _start_decode(
+        model, params, prompt, max_new_tokens
+    )
+    return _decode_loop(
+        model,
+        max_new_tokens,
+        sampling,
+        -1 if eos_token_id is None else int(eos_token_id),
+        int(pad_token_id),
+        params,
+        last_logits,
+        cache,
+        gen_mask,
+        rng,
+    )
+
+
+def _start_decode(model: Transformer, params: Any, prompt: jax.Array, max_new_tokens: int):
+    """Shared guards + prefill for ``generate`` and ``stream_tokens`` (one
+    source of truth — the two entry points must never diverge on bounds)."""
     cache_len = model.cache_len or model.cfg.max_seq_len
     B, T = prompt.shape
     # the final sampled token is never fed back, so cache holds T+max_new-1
@@ -93,24 +113,10 @@ def generate(
         )
     cache = init_cache(model, B)
     last_logits, cache = prefill(model, params, prompt, cache)
-    vocab = last_logits.shape[-1]
-
     # presence mask of *generated* tokens for the repetition penalty
     # (reference penalizes generated tokens only, app.py:75,85-88)
-    gen_mask = jnp.zeros((B, vocab), jnp.bool_)
-
-    return _decode_loop(
-        model,
-        max_new_tokens,
-        sampling,
-        -1 if eos_token_id is None else int(eos_token_id),
-        int(pad_token_id),
-        params,
-        last_logits,
-        cache,
-        gen_mask,
-        rng,
-    )
+    gen_mask = jnp.zeros((B, last_logits.shape[-1]), jnp.bool_)
+    return last_logits, cache, gen_mask
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
@@ -172,15 +178,6 @@ def _stream_sample(sampling, rng, logits, gen_mask):
     return token, gen_mask | newly
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
-def _stream_forward(model, params, token, cache):
-    # cache donated: in-place HBM update per token, like the fused loop
-    next_logits, vars_out = model.apply(
-        {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
-    )
-    return next_logits[:, -1, :].astype(jnp.float32), vars_out["cache"]
-
-
 def stream_tokens(
     model: Transformer,
     params: Any,
@@ -195,27 +192,14 @@ def stream_tokens(
     The per-token host round trip the reference's UI loop paid for every
     request (reference ``app.py:69-94``) — here an explicit OPT-IN for
     interactive streaming; use ``generate`` (single compiled while_loop) for
-    throughput. Each step is a jitted sample + a jitted cached forward (the
-    FINAL token's forward is skipped, matching ``generate``); rows that hit
+    throughput. Each step is a jitted sample + a jitted cached forward
+    (``prefill`` on the [B, 1] token — same compiled path; the FINAL token's
+    forward is skipped, matching ``generate``); rows that hit
     ``eos_token_id`` stop the stream when ALL rows are done (callers doing
     single-row streaming just break on their own EOS).
     """
-    cache_len = model.cache_len or model.cfg.max_seq_len
-    B, T = prompt.shape
-    if T + max_new_tokens - 1 > cache_len:
-        raise ValueError(
-            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"cache_len ({cache_len})"
-        )
-    if model.cfg.position == "learned" and T + max_new_tokens > model.cfg.max_seq_len:
-        raise ValueError(
-            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_seq_len ({model.cfg.max_seq_len}) and learned positions "
-            "cannot extrapolate (use position='alibi' or 'rope')"
-        )
-    cache = init_cache(model, B)
-    logits, cache = prefill(model, params, prompt, cache)
-    gen_mask = jnp.zeros((B, logits.shape[-1]), jnp.bool_)
+    logits, cache, gen_mask = _start_decode(model, params, prompt, max_new_tokens)
+    B = prompt.shape[0]
     done = jnp.zeros((B,), jnp.bool_)
     for step in range(max_new_tokens):
         rng, sub = jax.random.split(rng)
@@ -226,7 +210,7 @@ def stream_tokens(
             if bool(jnp.all(done)):
                 return
         if step + 1 < max_new_tokens:  # the last token is never fed back
-            logits, cache = _stream_forward(model, params, token, cache)
+            logits, cache = prefill(model, params, token[:, None], cache)
 
 
 def generate_tokens(

@@ -117,8 +117,11 @@ class TextGenerator:
             prompt, max_new_tokens, temperature, top_k, top_p,
             repetition_penalty, greedy,
         )
-        emitted: list = []
-        shown = 0
+        # committed-prefix decoding (HF TextStreamer pattern): only the
+        # UNCOMMITTED tail is re-decoded each step — O(n) total, not O(n^2)
+        # — and output is held back while the tail is an incomplete byte
+        # sequence (byte-level BPE chars can span tokens; decode -> U+FFFD)
+        pending: list = []
         for token in stream_tokens(
             self.model, self.params, jnp.asarray([ids], jnp.int32),
             max_new_tokens, jax.random.PRNGKey(seed), sampling,
@@ -127,20 +130,14 @@ class TextGenerator:
             t = int(token[0])
             if eos is not None and t == eos:
                 break
-            emitted.append(t)
-            # decode the whole tail each time so multi-token characters
-            # (byte-level BPE) render correctly; hold output back while the
-            # tail is an incomplete byte sequence (decodes to U+FFFD)
-            text = self.tokenizer.decode(emitted)
+            pending.append(t)
+            text = self.tokenizer.decode(pending)
             if text.endswith("�"):
                 continue
-            if len(text) > shown:
-                yield text[shown:]
-                shown = len(text)
-        # flush anything held back at stream end (genuine replacement chars)
-        text = self.tokenizer.decode(emitted)
-        if len(text) > shown:
-            yield text[shown:]
+            yield text
+            pending = []
+        if pending:  # flush a genuinely incomplete tail at stream end
+            yield self.tokenizer.decode(pending)
 
 
 def _build_generator(args) -> TextGenerator:
