@@ -23,7 +23,8 @@ block >300s) is killed and recorded instead of taking the whole capture down
      has a chance of fitting 16 GB v5e HBM, same 64k tokens/step via accum)
   4. TPU flash-attention microbenchmark sweep T in {1k,4k,8k,16k}
      (extra; only after a TPU success)
-  5. CPU smoke fallback     (only if every TPU scenario failed)
+  5. TPU KV-cache decode throughput (extra; only after a TPU success)
+  6. CPU smoke fallback     (only if every TPU scenario failed)
 
 The parent always exits 0 with exactly ONE parseable JSON line; errors ride
 in ``extra.errors``. Every string embedded in the output is truncated to
@@ -175,6 +176,70 @@ def child_train() -> dict:
     }
 
 
+def child_decode() -> dict:
+    """KV-cache decode throughput on the flagship config: one compiled
+    prefill + one compiled while_loop decode (the in-tree replacement for the
+    reference's CUDA inference side-car, ``torch_compatability/GPT2.py`` /
+    ``app.py``). bf16 params — decode is HBM-bandwidth-bound, so weight bytes
+    are the denominator that matters."""
+    import time
+
+    import jax
+
+    _force_platform()
+    import jax.numpy as jnp
+
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.inference.generate import decode_model, generate
+    from zero_transformer_tpu.inference.sampling import SamplingConfig
+
+    model_name = os.environ.get("BENCH_MODEL", "580m")
+    B = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "128"))
+    new = int(os.environ.get("BENCH_DECODE_NEW", "256"))
+
+    platform = jax.default_backend()
+    print(f"devices_ok platform={platform}", file=sys.stderr)
+    cfg = model_config(
+        model_name, dropout=0.0, param_dtype="bfloat16", compute_dtype="bfloat16"
+    )
+    model = decode_model(cfg, prompt_len + new)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (B, prompt_len), 0, cfg.vocab_size, jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(1), prompt[:, :8])["params"]
+    sampling = SamplingConfig(top_k=40, temperature=0.9)
+
+    t_compile = time.perf_counter()
+    out = generate(model, params, prompt, new, jax.random.PRNGKey(2), sampling)
+    out.block_until_ready()
+    import numpy as np  # sync barrier that survives the tunneled platform
+
+    np.asarray(out)
+    t_compile = time.perf_counter() - t_compile
+    print(f"compiled+decode0 in {t_compile:.1f}s", file=sys.stderr)
+
+    reps = int(os.environ.get("BENCH_DECODE_REPS", "3"))
+    t0 = time.perf_counter()
+    for i in range(reps):
+        out = generate(model, params, prompt, new, jax.random.PRNGKey(3 + i), sampling)
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / reps
+
+    return {
+        "ok": True,
+        "platform": platform,
+        "model": model_name,
+        "decode_tok_s": round(B * new / dt, 1),
+        "ms_per_token": round(dt / new * 1e3, 3),
+        "batch": B,
+        "prompt_len": prompt_len,
+        "new_tokens": new,
+        "compile_seconds": round(t_compile, 1),
+        "note": "wall time includes one prefill per rep",
+    }
+
+
 def child_loader() -> dict:
     """Tar-gzip loader throughput + prefetch-overlap microbench (CPU-only;
     no jax). See ``zero_transformer_tpu.data.loader_bench``."""
@@ -300,6 +365,7 @@ def main() -> None:
             result = {
                 "flash": child_flash,
                 "loader": child_loader,
+                "decode": child_decode,
             }.get(scenario, child_train)()
         except Exception as e:
             # XLA OOMs stringify to hundreds of KB — truncate HERE, at the
@@ -348,6 +414,9 @@ def main() -> None:
         flash = _run_child("flash", {}, 600.0)
         if not flash.get("ok"):
             errors.append(_truncate(f"flash: {flash.get('error')}"))
+        decode = _run_child("decode", {}, 600.0)
+        if not decode.get("ok"):
+            errors.append(_truncate(f"decode: {decode.get('error')}"))
         loader = _run_child("loader", {"BENCH_PLATFORM": "cpu"}, 300.0)
         if not loader.get("ok"):
             errors.append(_truncate(f"loader: {loader.get('error')}"))
@@ -360,6 +429,7 @@ def main() -> None:
             "extra": {
                 "scenarios": results,
                 "flash_microbench": flash,
+                "decode_microbench": decode,
                 "loader_microbench": loader,
                 "errors": errors,
             },

@@ -66,6 +66,12 @@ class ModelConfig:
     # activations-worth of HBM per block)
     remat_policy: str = "none"
     attention_impl: str = "auto"  # "auto" | "xla" | "flash" (pallas)
+    # Context-parallel engine when the mesh's `sequence` axis is active:
+    # "ring" (ppermute KV rotation, ops/ring_attention.py — any head count,
+    # best at very long T) or "ulysses" (two all-to-all reshards + one local
+    # flash call at full T, ops/ulysses.py — needs the sequence axis to
+    # divide the per-tensor-shard head counts).
+    cp_impl: str = "ring"
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     # Packed-sequence training: rows hold multiple documents separated by
@@ -150,6 +156,8 @@ class ModelConfig:
             raise ValueError("moe_top_k cannot exceed n_experts")
         if self.attention_impl not in ("auto", "xla", "flash"):
             raise ValueError(f"invalid attention_impl {self.attention_impl!r}")
+        if self.cp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"invalid cp_impl {self.cp_impl!r}")
         resolve_dtype(self.param_dtype)
         resolve_dtype(self.compute_dtype)
 

@@ -122,12 +122,20 @@ class Attention(nn.Module):
                 segment_ids=jnp.broadcast_to(kv_valid[None, :], (B, ck.value.shape[1])),
             )
         elif self.mesh is not None:
-            from zero_transformer_tpu.ops.ring_attention import ring_attention
+            if cfg.cp_impl == "ulysses":
+                from zero_transformer_tpu.ops.ulysses import ulysses_attention
 
-            out = ring_attention(
-                q, k, v, self.mesh, causal=True, alibi=cfg.position == "alibi",
-                doc_ids=doc_ids,
-            )
+                out = ulysses_attention(
+                    q, k, v, self.mesh, causal=True,
+                    alibi=cfg.position == "alibi", doc_ids=doc_ids,
+                )
+            else:
+                from zero_transformer_tpu.ops.ring_attention import ring_attention
+
+                out = ring_attention(
+                    q, k, v, self.mesh, causal=True,
+                    alibi=cfg.position == "alibi", doc_ids=doc_ids,
+                )
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, alibi=cfg.position == "alibi",

@@ -30,6 +30,7 @@ def xla_attention(
     segment_ids: Optional[jax.Array] = None,
     doc_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
+    slopes: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention via explicit einsums, softmax in float32.
 
@@ -38,6 +39,9 @@ def xla_attention(
       k, v: [B, Tkv, KVH, D]; KVH must divide H (GQA).
       q_offset: position of q[0] within the full sequence (decode w/ KV cache).
         May be a traced scalar.
+      slopes: optional [H] or [H, 1] f32 ALiBi slope override — for
+        head-sharded callers (ulysses / TP local attention) whose local head
+        0 is not global head 0.
       segment_ids: optional [B, Tkv] int mask; 0 = padding (masked out).
       doc_ids: optional [B, T] int document ids (Tq == Tkv); positions in
         DIFFERENT documents cannot attend to each other — the packed-sequence
@@ -54,7 +58,7 @@ def xla_attention(
     scores = scores * jnp.float32(scale)
 
     if alibi:
-        bias = alibi_bias(H, Tq, Tkv, offset=q_offset)  # [H, Tq, Tkv]
+        bias = alibi_bias(H, Tq, Tkv, offset=q_offset, slopes=slopes)  # [H, Tq, Tkv]
         if causal:
             bias = bias + causal_mask_bias(Tq, Tkv, offset=q_offset)[None]
         scores = scores + bias.reshape(1, KVH, G, Tq, Tkv)

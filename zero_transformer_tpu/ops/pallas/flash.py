@@ -385,29 +385,31 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, doc_ids, causal, alibi, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, doc_ids, slopes, causal, alibi, scale, block_q, block_k, interpret):
     # doc_ids: [B, T] float32 (or None) — f32 so its zero cotangent below is
-    # a plain zeros_like rather than float0 plumbing
+    # a plain zeros_like rather than float0 plumbing. slopes: [H, 1] f32 (or
+    # None) overriding the ALiBi table for head-sharded callers (ulysses/TP).
     o, _ = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
-                q_ids=doc_ids, k_ids=doc_ids)
+                slopes=slopes, q_ids=doc_ids, k_ids=doc_ids)
     return o
 
 
-def _flash_fwd(q, k, v, doc_ids, causal, alibi, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, doc_ids, slopes, causal, alibi, scale, block_q, block_k, interpret):
     o, lse = _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
-                  q_ids=doc_ids, k_ids=doc_ids)
-    return o, (q, k, v, doc_ids, o, lse)
+                  slopes=slopes, q_ids=doc_ids, k_ids=doc_ids)
+    return o, (q, k, v, doc_ids, slopes, o, lse)
 
 
 def _flash_bwd(causal, alibi, scale, block_q, block_k, interpret, res, do):
-    q, k, v, doc_ids, o, lse = res
+    q, k, v, doc_ids, slopes, o, lse = res
     dq, dk, dv = _bwd(
         q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
-        q_ids=doc_ids, k_ids=doc_ids,
+        slopes=slopes, q_ids=doc_ids, k_ids=doc_ids,
     )
     d_ids = None if doc_ids is None else jnp.zeros_like(doc_ids)
-    return dq, dk, dv, d_ids
+    d_slopes = None if slopes is None else jnp.zeros_like(slopes)
+    return dq, dk, dv, d_ids, d_slopes
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -433,6 +435,7 @@ def flash_attention(
     alibi: bool = False,
     doc_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
+    slopes: Optional[jax.Array] = None,
     block: Optional[int] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
@@ -441,7 +444,9 @@ def flash_attention(
     """Differentiable flash attention. q [B,T,H,D]; k,v [B,S,KVH,D].
 
     ``doc_ids`` [B, T] int: packed-sequence document mask (requires T == S;
-    different ids cannot attend to each other)."""
+    different ids cannot attend to each other). ``slopes`` [H, 1] f32
+    overrides the ALiBi slope table — for head-sharded callers (ulysses / TP
+    local attention) whose local head 0 is not global head 0."""
     B, T, H, D = q.shape
     _, S, KVH, _ = k.shape
     if H % KVH:
@@ -452,7 +457,8 @@ def flash_attention(
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
     ids = None if doc_ids is None else doc_ids.astype(jnp.float32)
     return _flash(
-        q, k, v, ids, causal, alibi, float(scale), block_q, block_k, interpret
+        q, k, v, ids, slopes, causal, alibi, float(scale), block_q, block_k,
+        interpret,
     )
 
 

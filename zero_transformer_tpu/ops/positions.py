@@ -38,7 +38,8 @@ def alibi_slopes(n_heads: int, dtype=jnp.float32) -> jax.Array:
 
 
 def alibi_bias(
-    n_heads: int, q_len: int, kv_len: int, offset: int = 0, dtype=jnp.float32
+    n_heads: int, q_len: int, kv_len: int, offset: int = 0, dtype=jnp.float32,
+    slopes=None,
 ) -> jax.Array:
     """[n_heads, q_len, kv_len] additive attention bias: -slope * distance.
 
@@ -46,12 +47,17 @@ def alibi_bias(
     single-query decode with a KV cache, where q position = offset (the
     capability the reference's Flax side lacks and its torch side rebuilds
     dynamically, reference ``torch_compatability/GPT2.py:191-235``).
+    ``slopes`` ([n_heads] or [n_heads, 1]) overrides the slope table for
+    head-sharded callers whose local head 0 is not global head 0.
     """
     q_pos = jnp.arange(q_len, dtype=jnp.int32) + offset
     kv_pos = jnp.arange(kv_len, dtype=jnp.int32)
     # distance to the key, clamped at 0 (future keys are masked separately)
     dist = jnp.maximum(q_pos[:, None] - kv_pos[None, :], 0).astype(dtype)
-    return -alibi_slopes(n_heads, dtype)[:, None, None] * dist[None, :, :]
+    if slopes is None:
+        slopes = alibi_slopes(n_heads, dtype)
+    slopes = slopes.reshape(n_heads).astype(dtype)
+    return -slopes[:, None, None] * dist[None, :, :]
 
 
 def causal_mask_bias(q_len: int, kv_len: int, offset: int = 0, dtype=jnp.float32) -> jax.Array:

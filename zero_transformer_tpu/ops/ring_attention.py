@@ -82,6 +82,18 @@ def _rotate(x, axis_name: str, n: int):
     return jax.lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
 
 
+def _validate_cp_shapes(kind: str, T: int, S: int, n: int, tp: int, H: int, KVH: int):
+    """Shared entry guards for the context-parallel engines (ring / ulysses)."""
+    if T != S:
+        raise ValueError(f"{kind} attention requires q and kv sequence lengths equal")
+    if T % n:
+        raise ValueError(f"sequence length {T} not divisible by sequence axis {n}")
+    if tp > 1 and (H % tp or KVH % tp):
+        raise ValueError(f"heads ({H}, {KVH}) not divisible by tensor axis {tp}")
+    if H % KVH:
+        raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
+
+
 # -- flash-backed ring (custom VJP) ------------------------------------------
 
 
@@ -390,16 +402,9 @@ def ring_attention(
     """
     B, T, H, D = q.shape
     _, S, KVH, _ = k.shape
-    if T != S:
-        raise ValueError("ring attention requires q and kv sequence lengths equal")
     n = mesh.shape[SEQUENCE_AXIS]
     tp = mesh.shape[TENSOR_AXIS]
-    if T % n:
-        raise ValueError(f"sequence length {T} not divisible by sequence axis {n}")
-    if tp > 1 and (H % tp or KVH % tp):
-        raise ValueError(f"heads ({H}, {KVH}) not divisible by tensor axis {tp}")
-    if H % KVH:
-        raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
+    _validate_cp_shapes("ring", T, S, n, tp, H, KVH)
     scale = float(softmax_scale if softmax_scale is not None else 1.0 / (D**0.5))
     qkv_spec, lse_spec = _specs(mesh, B, tp)
     ids_spec = P(qkv_spec[0], SEQUENCE_AXIS)

@@ -47,6 +47,16 @@ class TextGenerator:
         self.model = decode_model(cfg, self.cache_len)
         self.params = params
 
+    def _decode(self, toks) -> str:
+        """Detokenize WITHOUT clean_up_tokenization_spaces: the cleanup pass
+        rewrites across token boundaries (" n" + "'t" -> "n't"), so a chunked
+        streaming decode would diverge from the whole-sequence decode unless
+        both paths pin it off. Falls back for tokenizers without the kwarg."""
+        try:
+            return self.tokenizer.decode(toks, clean_up_tokenization_spaces=False)
+        except TypeError:
+            return self.tokenizer.decode(toks)
+
     def __call__(
         self,
         prompt: str,
@@ -77,7 +87,7 @@ class TextGenerator:
             pad_token_id=eos if eos is not None else 0,
         )
         toks = [t for t in out[0].tolist() if t != eos]
-        return self.tokenizer.decode(toks)
+        return self._decode(toks)
 
     def _prepare(
         self, prompt, max_new_tokens, temperature, top_k, top_p,
@@ -131,13 +141,13 @@ class TextGenerator:
             if eos is not None and t == eos:
                 break
             pending.append(t)
-            text = self.tokenizer.decode(pending)
+            text = self._decode(pending)
             if text.endswith("�"):
                 continue
             yield text
             pending = []
         if pending:  # flush a genuinely incomplete tail at stream end
-            yield self.tokenizer.decode(pending)
+            yield self._decode(pending)
 
 
 def _build_generator(args) -> TextGenerator:
