@@ -125,3 +125,36 @@ def test_stream_tokens_matches_generate_greedy():
     want = generate(dec, params, prompt, 8, rng, cfg)
     got = [int(t[0]) for t in stream_tokens(dec, params, prompt, 8, rng, cfg)]
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want[0]))
+
+
+def test_byte_tokenizer_roundtrip():
+    """--tokenizer bytes: offline fallback; UTF-8 round-trips exactly,
+    including multi-byte characters, and streams through a byte-vocab model."""
+    from zero_transformer_tpu.serve import ByteTokenizer, _load_tokenizer
+
+    tok = _load_tokenizer("bytes")
+    assert isinstance(tok, ByteTokenizer)
+    text = "héllo ∀x"
+    ids = tok.encode(text)
+    assert all(0 <= t < 256 for t in ids)
+    assert tok.decode(ids) == text
+    # the serve streaming path holds back incomplete multi-byte sequences
+    partial = tok.decode(ids[:2])  # b'h\xc3' — dangling UTF-8 lead byte
+    assert partial.endswith("�")
+
+
+def test_generator_with_byte_tokenizer():
+    from zero_transformer_tpu.serve import ByteTokenizer
+
+    cfg = dataclasses.replace(CFG, vocab_size=256)
+    model = Transformer(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    gen = TextGenerator(cfg, params, ByteTokenizer(), cache_len=32)
+    out = gen("hi", max_new_tokens=8, greedy=True)
+    assert isinstance(out, str)
+    # greedy + same seed: the streamed concatenation must equal the batch
+    # decode exactly (the _decode cleanup pinning exists for this invariant)
+    streamed = "".join(gen.stream("hi", max_new_tokens=8, greedy=True))
+    assert streamed == out

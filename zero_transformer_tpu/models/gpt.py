@@ -123,19 +123,14 @@ class Attention(nn.Module):
             )
         elif self.mesh is not None:
             if cfg.cp_impl == "ulysses":
-                from zero_transformer_tpu.ops.ulysses import ulysses_attention
-
-                out = ulysses_attention(
-                    q, k, v, self.mesh, causal=True,
-                    alibi=cfg.position == "alibi", doc_ids=doc_ids,
-                )
+                from zero_transformer_tpu.ops.ulysses import ulysses_attention as cp_attn
             else:
-                from zero_transformer_tpu.ops.ring_attention import ring_attention
+                from zero_transformer_tpu.ops.ring_attention import ring_attention as cp_attn
 
-                out = ring_attention(
-                    q, k, v, self.mesh, causal=True,
-                    alibi=cfg.position == "alibi", doc_ids=doc_ids,
-                )
+            out = cp_attn(
+                q, k, v, self.mesh, causal=True,
+                alibi=cfg.position == "alibi", doc_ids=doc_ids,
+            )
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, alibi=cfg.position == "alibi",

@@ -95,7 +95,7 @@ def _scores(
         slope, q_pos0, k_pos0, q.shape[0], k.shape[0], alibi, causal
     )
     if docs:
-        same = qid_ref[0, :][:, None] == kid_ref[0, :][None, :]
+        same = qid_ref[0, 0, :][:, None] == kid_ref[0, 0, :][None, :]
         s = s + jnp.where(same, 0.0, NEG_INF).astype(jnp.float32)
     return s
 
@@ -110,10 +110,13 @@ def _run_predicate(offs_ref, i, j, block_q: int, block_k: int, causal: bool):
 
 
 def _fwd_kernel(
-    slope_ref, offs_ref, qid_ref, kid_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-    m_scr, l_scr, acc_scr,
-    *, scale: float, causal: bool, alibi: bool, docs: bool, n_k: int,
+    slope_ref, offs_ref, *args,
+    scale: float, causal: bool, alibi: bool, docs: bool, n_k: int,
 ):
+    # id operands exist ONLY when document masking is on: their per-grid-step
+    # VMEM copies measurably slow the un-masked path (~2x at T=1024 on v5e)
+    qid_ref, kid_ref = (args[0], args[1]) if docs else (None, None)
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = args[2 if docs else 0:]
     i, j = pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
     block_q, block_k = q_ref.shape[2], k_ref.shape[2]
@@ -152,11 +155,12 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    slope_ref, offs_ref, qid_ref, kid_ref, q_ref, k_ref, v_ref, do_ref,
-    lse_ref, delta_ref, dq_ref,
-    dq_scr,
-    *, scale: float, causal: bool, alibi: bool, docs: bool, n_k: int,
+    slope_ref, offs_ref, *args,
+    scale: float, causal: bool, alibi: bool, docs: bool, n_k: int,
 ):
+    qid_ref, kid_ref = (args[0], args[1]) if docs else (None, None)
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+     dq_scr) = args[2 if docs else 0:]
     i, j = pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
     block_q, block_k = q_ref.shape[2], k_ref.shape[2]
@@ -189,12 +193,12 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    slope_ref, offs_ref, qid_ref, kid_ref, q_ref, k_ref, v_ref, do_ref,
-    lse_ref, delta_ref,
-    dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, scale: float, causal: bool, alibi: bool, docs: bool, n_q: int,
+    slope_ref, offs_ref, *args,
+    scale: float, causal: bool, alibi: bool, docs: bool, n_q: int,
 ):
+    qid_ref, kid_ref = (args[0], args[1]) if docs else (None, None)
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+     dk_scr, dv_scr) = args[2 if docs else 0:]
     # grid: (B, H, n_k, n_q) — j is the k-block, inner index i walks q-blocks
     j, i = pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
@@ -249,18 +253,17 @@ def _smem_spec():
 
 
 def _ids_args(q_ids, k_ids, B, T, S):
-    """Always-present [B, T]/[B, S] f32 id arrays (zeros when unused — the
-    static ``docs`` flag keeps the disabled path free of mask compute)."""
-    qi = (
-        jnp.zeros((B, T), jnp.float32)
-        if q_ids is None
-        else q_ids.astype(jnp.float32)
-    )
-    ki = (
-        jnp.zeros((B, S), jnp.float32)
-        if k_ids is None
-        else k_ids.astype(jnp.float32)
-    )
+    """[B, 1, T]/[B, 1, S] f32 id arrays — built only when document masking
+    is on (the operands and their per-grid-step VMEM copies cost ~2x at
+    T=1024 when present but unused).
+
+    The singleton middle axis is load-bearing: Mosaic requires the last two
+    block dims to be (div 8, div 128) or equal to the array dims. A [B, T]
+    layout with (1, block) blocks violates the sublane rule on real TPUs
+    (interpret mode does not enforce it); [B, 1, T] with (1, 1, block)
+    blocks is legal (1 == array dim, block >= 128)."""
+    qi = q_ids.astype(jnp.float32).reshape(B, 1, T)
+    ki = k_ids.astype(jnp.float32).reshape(B, 1, S)
     return qi, ki
 
 
@@ -275,21 +278,22 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
     _, KVH, S, _ = k.shape
     G = H // KVH
     n_q, n_k = T // block_q, S // block_k
-    qi, ki = _ids_args(q_ids, k_ids, B, T, S)
+    id_args = _ids_args(q_ids, k_ids, B, T, S) if docs else ()
 
     if slopes is None:
         slopes = _slopes_arg(H, alibi)
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
-    qid_spec = pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i))
-    kid_spec = pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j))
+    qid_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, 0, i))
+    kid_spec = pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j))
+    id_specs = [qid_spec, kid_spec] if docs else []
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, alibi=alibi, docs=docs,
             n_k=n_k,
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[_smem_spec(), _smem_spec(), qid_spec, kid_spec,
+        in_specs=[_smem_spec(), _smem_spec(), *id_specs,
                   q_spec, kv_spec, kv_spec],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -305,7 +309,7 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, D), jnp.float32),  # acc
         ],
         interpret=interpret,
-    )(slopes, _offsets_arg(q_offset, kv_offset), qi, ki, q, k, v)
+    )(slopes, _offsets_arg(q_offset, kv_offset), *id_args, q, k, v)
     return jnp.swapaxes(o, 1, 2), lse
 
 
@@ -318,7 +322,7 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
     _, KVH, S, _ = k.shape
     G = H // KVH
     n_q, n_k = T // block_q, S // block_k
-    qi, ki = _ids_args(q_ids, k_ids, B, T, S)
+    id_args = _ids_args(q_ids, k_ids, B, T, S) if docs else ()
 
     if delta is None:  # rowsum(do * o) — loop-invariant for ring callers
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None]
@@ -330,22 +334,23 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
     kv_spec_iq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
     row_spec_iq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
-    qid_spec_iq = pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i))
-    kid_spec_iq = pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j))
+    qid_spec_iq = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, 0, i))
+    kid_spec_iq = pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j))
+    id_specs_iq = [qid_spec_iq, kid_spec_iq] if docs else []
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, alibi=alibi, docs=docs,
             n_k=n_k,
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[_smem_spec(), _smem_spec(), qid_spec_iq, kid_spec_iq,
+        in_specs=[_smem_spec(), _smem_spec(), *id_specs_iq,
                   q_spec_iq, kv_spec_iq, kv_spec_iq,
                   q_spec_iq, row_spec_iq, row_spec_iq],
         out_specs=q_spec_iq,
         out_shape=jax.ShapeDtypeStruct(q.shape, grad_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(slopes, offs, qi, ki, q, k, v, do, lse, delta)
+    )(slopes, offs, *id_args, q, k, v, do, lse, delta)
 
     # k-block-major grid; q walked innermost. dk/dv computed per *query* head
     # ([B, H, S, D]) then group-summed to KVH for GQA.
@@ -353,15 +358,16 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
     kv_spec_jq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h // G, j, 0))
     kv_out_jq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
     row_spec_jq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0))
-    qid_spec_jq = pl.BlockSpec((1, block_q), lambda b, h, j, i: (b, i))
-    kid_spec_jq = pl.BlockSpec((1, block_k), lambda b, h, j, i: (b, j))
+    qid_spec_jq = pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, 0, i))
+    kid_spec_jq = pl.BlockSpec((1, 1, block_k), lambda b, h, j, i: (b, 0, j))
+    id_specs_jq = [qid_spec_jq, kid_spec_jq] if docs else []
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, alibi=alibi, docs=docs,
             n_q=n_q,
         ),
         grid=(B, H, n_k, n_q),
-        in_specs=[_smem_spec(), _smem_spec(), qid_spec_jq, kid_spec_jq,
+        in_specs=[_smem_spec(), _smem_spec(), *id_specs_jq,
                   q_spec_jq, kv_spec_jq, kv_spec_jq,
                   q_spec_jq, row_spec_jq, row_spec_jq],
         out_specs=[kv_out_jq, kv_out_jq],
@@ -374,7 +380,7 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(slopes, offs, qi, ki, q, k, v, do, lse, delta)
+    )(slopes, offs, *id_args, q, k, v, do, lse, delta)
 
     dq = jnp.swapaxes(dq, 1, 2)
     dk = jnp.swapaxes(dk, 1, 2)  # [B, S, H, D]
@@ -446,13 +452,18 @@ def flash_attention(
     ``doc_ids`` [B, T] int: packed-sequence document mask (requires T == S;
     different ids cannot attend to each other). ``slopes`` [H, 1] f32
     overrides the ALiBi slope table — for head-sharded callers (ulysses / TP
-    local attention) whose local head 0 is not global head 0."""
+    local attention) whose local head 0 is not global head 0. Slopes are
+    treated as a CONSTANT of the kernel (stop_gradient applied): unlike the
+    XLA path, the custom VJP does not propagate slope gradients — do not use
+    this entry point with learnable slopes."""
     B, T, H, D = q.shape
     _, S, KVH, _ = k.shape
     if H % KVH:
         raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
     if doc_ids is not None and T != S:
         raise ValueError("doc_ids requires full-sequence shapes (T == S)")
+    if slopes is not None:
+        slopes = jax.lax.stop_gradient(slopes)
     block_q, block_k = _resolve_blocks(T, S, block, block_q, block_k)
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
     ids = None if doc_ids is None else doc_ids.astype(jnp.float32)

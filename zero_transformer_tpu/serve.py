@@ -26,10 +26,29 @@ import jax
 import jax.numpy as jnp
 
 
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: token id = byte value (vocab 256).
+
+    ``--tokenizer bytes``: a zero-dependency, zero-download fallback so the
+    serve surface works on air-gapped machines and with byte-vocab models
+    (the ``test`` zoo entry). No EOS — generation runs to max_new_tokens."""
+
+    eos_token_id = None
+
+    def encode(self, text: str):
+        return list(text.encode("utf-8"))
+
+    def decode(self, toks, **kwargs) -> str:
+        return bytes(t for t in toks if 0 <= t < 256).decode("utf-8", errors="replace")
+
+
 def _load_tokenizer(name_or_path: str):
     """GPT-NeoX tokenizer by default (what the reference trained with,
     reference ``app.py:27``). Must resolve locally — this environment has no
-    egress, so pass a local path when the HF cache is cold."""
+    egress, so pass a local path when the HF cache is cold, or ``bytes`` for
+    the built-in byte-level fallback."""
+    if name_or_path == "bytes":
+        return ByteTokenizer()
     from transformers import AutoTokenizer
 
     return AutoTokenizer.from_pretrained(name_or_path)
