@@ -197,11 +197,13 @@ def child_decode() -> dict:
     B = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
     prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "128"))
     new = int(os.environ.get("BENCH_DECODE_NEW", "256"))
+    kv_dtype = os.environ.get("BENCH_DECODE_KV", "auto")
 
     platform = jax.default_backend()
     print(f"devices_ok platform={platform}", file=sys.stderr)
     cfg = model_config(
-        model_name, dropout=0.0, param_dtype="bfloat16", compute_dtype="bfloat16"
+        model_name, dropout=0.0, param_dtype="bfloat16",
+        compute_dtype="bfloat16", kv_cache_dtype=kv_dtype,
     )
     model = decode_model(cfg, prompt_len + new)
     prompt = jax.random.randint(
@@ -235,6 +237,7 @@ def child_decode() -> dict:
         "batch": B,
         "prompt_len": prompt_len,
         "new_tokens": new,
+        "kv_cache_dtype": kv_dtype,
         "compile_seconds": round(t_compile, 1),
         "note": "wall time includes one prefill per rep",
     }

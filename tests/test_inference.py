@@ -218,3 +218,65 @@ def test_generate_overflow_rejected():
         generate(
             model, params, jnp.zeros((1, 6), jnp.int32), 6, jax.random.PRNGKey(0)
         )
+
+
+# -- int8 KV cache ------------------------------------------------------------
+
+
+def test_int8_kv_cache_decode_close_to_full_forward():
+    """kv_cache_dtype=int8: prefill + cached decode tracks the uncached
+    forward logits within quantization tolerance, the cache variables really
+    store int8 + f32 scales, and dequantized K/V stay within the int8 grid's
+    error bound of the exact values."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    full = Transformer(dataclasses.replace(CFG))
+    dec = decode_model(cfg, cache_len=16)
+    B, T = 2, 10
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+    params = _params(full, B, T)
+
+    ref_logits = full.apply({"params": params}, x)
+
+    cache = init_cache(dec, B)
+    jax.tree.map(lambda _: None, cache)  # structure sanity
+    last, cache = prefill(dec, params, x[:, :4], cache)
+    # one layer's cache leaves: int8 values + f32 scales
+    leaves = jax.tree.leaves(cache)
+    assert any(l.dtype == jnp.int8 for l in leaves)
+    # scan_layers stacks a leading layer axis, so scale leaves are >=4-D
+    assert any(l.dtype == jnp.float32 and l.ndim >= 4 and l.shape[-1] == 1 for l in leaves)
+
+    np.testing.assert_allclose(last, ref_logits[:, 3], atol=0.08, rtol=0.05)
+    for t in range(4, T):
+        logits, vars_out = dec.apply(
+            {"params": params, "cache": cache}, x[:, t : t + 1], mutable=["cache"]
+        )
+        cache = vars_out["cache"]
+        np.testing.assert_allclose(
+            logits[:, 0], ref_logits[:, t], atol=0.08, rtol=0.05,
+            err_msg=f"position {t}",
+        )
+    # greedy tokens agree between int8 and full-precision decode
+    out_q = generate(dec, params, x[:, :4], 6, jax.random.PRNGKey(1),
+                     SamplingConfig(greedy=True))
+    dec_fp = decode_model(CFG, cache_len=16)
+    out_fp = generate(dec_fp, params, x[:, :4], 6, jax.random.PRNGKey(1),
+                      SamplingConfig(greedy=True))
+    assert int((out_q == out_fp).sum()) >= 4  # near-argmax ties may flip
+
+
+def test_quantize_kv_roundtrip_bound():
+    from zero_transformer_tpu.models.gpt import _quantize_kv
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 3, 16)) * 3.0
+    q, scale = _quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.dtype == jnp.float32
+    deq = q.astype(jnp.float32) * scale
+    # symmetric round-to-nearest: |err| <= scale/2 elementwise
+    assert bool(jnp.all(jnp.abs(deq - x) <= scale / 2 + 1e-7))
+    # zeros stay exactly zero
+    qz, sz = _quantize_kv(jnp.zeros((1, 2, 1, 8)))
+    assert bool(jnp.all(qz == 0)) and bool(jnp.all(qz.astype(jnp.float32) * sz == 0))

@@ -46,6 +46,17 @@ def _dense(features: int, axes: Tuple, std: float, dtype, param_dtype, name: str
     )
 
 
+def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization over the head dim: [B, T, KVH, D] ->
+    (int8 values, f32 scale [B, T, KVH, 1]). Round-to-nearest; scale floored
+    so all-zero rows stay exactly zero after dequant."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _norm(cfg: ModelConfig, dtype, name: str):
     kwargs = dict(
         dtype=dtype,
@@ -86,11 +97,18 @@ class Attention(nn.Module):
 
         use_cache = False
         offset = 0
+        int8_cache = cfg.kv_cache_dtype == "int8"
         if self.decode:
             max_len = self.cache_len or cfg.max_seq_len
             is_init = not self.has_variable("cache", "cached_key")
-            ck = self.variable("cache", "cached_key", jnp.zeros, (B, max_len, KVH, D), dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros, (B, max_len, KVH, D), dtype)
+            cache_dtype = jnp.int8 if int8_cache else dtype
+            ck = self.variable("cache", "cached_key", jnp.zeros, (B, max_len, KVH, D), cache_dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, (B, max_len, KVH, D), cache_dtype)
+            if int8_cache:
+                # per-(token, head) symmetric scales; f32 so tiny magnitudes
+                # don't underflow the dequant product
+                ksc = self.variable("cache", "key_scale", jnp.zeros, (B, max_len, KVH, 1), jnp.float32)
+                vsc = self.variable("cache", "value_scale", jnp.zeros, (B, max_len, KVH, 1), jnp.float32)
             idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
             use_cache = not is_init
             if use_cache:
@@ -102,8 +120,25 @@ class Attention(nn.Module):
             k = apply_rope(k, pos, cfg.rope_theta)  # cache stores rotated keys
 
         if use_cache:
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+            if int8_cache:
+                kq, k_scale = _quantize_kv(k)
+                vq, v_scale = _quantize_kv(v)
+                ck.value = jax.lax.dynamic_update_slice(ck.value, kq, (0, offset, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, vq, (0, offset, 0, 0))
+                ksc.value = jax.lax.dynamic_update_slice(ksc.value, k_scale, (0, offset, 0, 0))
+                vsc.value = jax.lax.dynamic_update_slice(vsc.value, v_scale, (0, offset, 0, 0))
+                # dequant fuses into the attention reads; the cache is a
+                # loop carry of the decode while_loop, so XLA cannot hoist
+                # this out — HBM traffic stays at int8 + one f32 scale per
+                # (token, head) instead of bf16 K/V
+                # multiply in f32 (scales are stored f32 for exactly this),
+                # round once at the end
+                k_all = (ck.value.astype(jnp.float32) * ksc.value).astype(dtype)
+                v_all = (cv.value.astype(jnp.float32) * vsc.value).astype(dtype)
+            else:
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+                k_all, v_all = ck.value, cv.value
             idx.value = offset + T
             kv_valid = (jnp.arange(ck.value.shape[1]) < offset + T).astype(jnp.int32)
             # Writing past capacity would silently clamp onto the last slot
@@ -114,8 +149,8 @@ class Attention(nn.Module):
             q = jnp.where(overflow, jnp.nan, 1.0).astype(q.dtype) * q
             out = xla_attention(
                 q,
-                ck.value,
-                cv.value,
+                k_all,
+                v_all,
                 causal=T > 1,
                 alibi=cfg.position == "alibi",
                 q_offset=offset,
