@@ -203,12 +203,13 @@ def test_ring_doc_mask_matches_full_attention(devices, impl, kwargs):
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-3, err_msg=f"d{name}")
 
 
-def test_packed_model_with_sequence_parallel_matches_single(devices):
+@pytest.mark.parametrize("cp_impl", ["ring", "ulysses"])
+def test_packed_model_with_sequence_parallel_matches_single(devices, cp_impl):
     """Full packed model under a sequence-parallel mesh == unsharded."""
     from zero_transformer_tpu.config import MeshConfig
     from zero_transformer_tpu.parallel.mesh import make_mesh
 
-    cfg = dataclasses.replace(CFG, max_seq_len=32)
+    cfg = dataclasses.replace(CFG, max_seq_len=32, cp_impl=cp_impl)
     mesh = make_mesh(MeshConfig(data=2, sequence=4))
     rng = np.random.default_rng(3)
     row = np.concatenate([rng.integers(1, 60, 13), [SEP], rng.integers(1, 60, 18)])

@@ -125,3 +125,23 @@ def test_pp_rejects_zero2_and_indivisible(devices):
     plan_tp = make_plan(model, tx, mesh_tp, (2, 16), 1)
     with pytest.raises(NotImplementedError, match="tensor"):
         make_train_step(model, tx, mesh_tp, plan_tp, 1)
+
+
+def test_pp_packed_matches_dp_trajectory(devices):
+    """Packed-sequence training through the pipeline wavefront: every rank
+    derives the microbatch's document ids from the (pipe-replicated) batch,
+    so masking and boundary-ignored loss match the fused step exactly."""
+    cfg = dataclasses.replace(CFG, doc_sep_token=0)
+    mesh_pp, s_pp, step_pp = _setup(MeshConfig(pipe=2, data=4), model_cfg=cfg)
+    mesh_dp, s_dp, step_dp = _setup(MeshConfig(), model_cfg=cfg)
+    rng = jax.random.PRNGKey(11)
+    for i in range(2):
+        batch = np.array(_batch(i))  # writable copy
+        batch[:, :, 5] = 0  # separators straddling rows: 2+ docs per row
+        batch[:, 1::2, 11] = 0
+        batch = jnp.asarray(batch)
+        s_pp, mp = step_pp(s_pp, batch, rng)
+        s_dp, md = step_dp(s_dp, batch, rng)
+    np.testing.assert_allclose(float(mp["loss"]), float(md["loss"]), rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(s_pp.params), jax.tree.leaves(s_dp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)

@@ -83,11 +83,28 @@ def test_dtype_overflow_rejected(corpus, tmp_path):
 
 
 def test_negative_sep_rejected_not_wrapped(corpus, tmp_path):
-    """int32 -1 silently wraps to uint16 65535 under astype — must error, not
-    bake out-of-vocab garbage into every document boundary."""
-    with pytest.raises(ValueError, match="out of range"):
-        main([
-            "--input", str(corpus / "*.txt"), "--tokenizer", "bytes",
-            "--max-context", "8", "--format", "memmap",
-            "--out", str(tmp_path / "y.bin"), "--doc-sep", "-1",
-        ])
+    """A negative separator must error up front for BOTH formats — memmap
+    would wrap it (int32 -1 -> uint16 65535) and tar would store it verbatim
+    for nn.Embed to clamp silently at train time."""
+    for fmt in ("memmap", "tar"):
+        with pytest.raises(ValueError, match="doc-sep"):
+            main([
+                "--input", str(corpus / "*.txt"), "--tokenizer", "bytes",
+                "--max-context", "8", "--format", fmt,
+                "--out", str(tmp_path / f"y_{fmt}"), "--doc-sep", "-1",
+            ])
+
+
+def test_tar_index_resolves_from_any_cwd(corpus, tmp_path, monkeypatch):
+    """The .index must be relocatable: shard paths are absolutized so
+    training launched from a different cwd still finds them."""
+    prefix = tmp_path / "shards" / "c"
+    main([
+        "--input", str(corpus / "*.txt"), "--tokenizer", "bytes",
+        "--max-context", "8", "--format", "tar", "--out", str(prefix),
+        "--doc-sep", "0",
+    ])
+    monkeypatch.chdir("/")
+    src = TarShardSource(f"{prefix}.index", max_context=8,
+                         shuffle_shards=False, strict=True)
+    assert next(iter(src)).shape == (8,)

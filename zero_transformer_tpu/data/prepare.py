@@ -144,7 +144,9 @@ def write_tar_shards(
     try:
         for i, row in enumerate(rows):
             if tar is None:
-                shard_path = Path(f"{out_prefix}-{len(shards):05d}.tar.gz")
+                # absolute: the .index must resolve from any cwd, not just
+                # the directory prepare happened to run in
+                shard_path = Path(f"{out_prefix}-{len(shards):05d}.tar.gz").resolve()
                 tar = tarfile.open(shard_path, "w:gz")
                 shards.append(shard_path)
                 in_shard = 0
@@ -192,6 +194,12 @@ def main(argv=None) -> None:
     sep = args.doc_sep
     if sep is None:
         sep = getattr(tokenizer, "eos_token_id", None)
+    # validated HERE, once, for both output formats — the tar path stores
+    # int32 and would otherwise bake a negative separator into every
+    # document boundary (nn.Embed clamps out-of-bounds ids silently under
+    # jit, so this would train on wrong embeddings with no error)
+    if sep is not None and sep < 0:
+        raise ValueError(f"--doc-sep must be a valid token id, got {sep}")
     rows = pack_rows(
         iter_documents(args.input), tokenizer, args.max_context, sep
     )

@@ -94,11 +94,7 @@ def make_pp_train_step(
         raise NotImplementedError(
             "pipeline parallelism supports alibi/rope positions"
         )
-    if cfg.doc_sep_token is not None:
-        raise NotImplementedError(
-            "packed-sequence doc masking is not plumbed through the pipeline "
-            "wavefront (its stage carry and head loss are unmasked)"
-        )
+    packed = cfg.doc_sep_token is not None
     l_local = cfg.n_layers // n_stages
     dtype = resolve_dtype(cfg.compute_dtype)
     param_dtype = resolve_dtype(cfg.param_dtype)
@@ -145,6 +141,15 @@ def make_pp_train_step(
             x = batch[jnp.clip(i, 0, M - 1)]
             return embed_mod.apply({"params": params["wte"]}, x)
 
+        def ids_mb(i):
+            # every rank holds the full (pipe-replicated) batch, so the
+            # packed-document ids need not ride the stage carry hops — each
+            # rank derives them for whatever microbatch it is working on
+            # (same exclusive-cumsum rule as models/gpt.py)
+            x = batch[jnp.clip(i, 0, M - 1)]
+            is_sep = (x == cfg.doc_sep_token).astype(jnp.int32)
+            return jnp.cumsum(is_sep, axis=1) - is_sep
+
         def head_loss_mb(h, i):
             x = batch[jnp.clip(i, 0, M - 1)]
             h = norm_mod.apply({"params": params["ln_f"]}, h)
@@ -154,6 +159,15 @@ def make_pp_train_step(
                 )
             else:
                 logits = head_mod.apply({"params": params["lm_head"]}, h)
+            if packed:
+                # never predict the first token of the NEXT document
+                # (models/gpt.py boundary masking, verbatim semantics)
+                ids = ids_mb(i)
+                boundary = ids[:, 1:] != ids[:, :-1]
+                labels = jnp.concatenate(
+                    [x[:, :1], jnp.where(boundary, -1, x[:, 1:])], axis=1
+                )
+                return next_token_loss(logits, labels, ignore_index=-1)
             return next_token_loss(logits, x)
 
         def tick(carry, t):
@@ -168,9 +182,12 @@ def make_pp_train_step(
             mb = t - rank  # microbatch this rank works on at tick t
             h_in = jnp.where(rank == 0, embed_mb(t), inbox)
             mrng = jax.random.fold_in(jax.random.fold_in(rng, mb), rank)
-            (h_out, aux), _ = stage_mod.apply(
+            carry_in = (h_in.astype(dtype), jnp.zeros((), jnp.float32))
+            if packed:
+                carry_in = carry_in + (ids_mb(mb),)
+            (h_out, aux, *_), _ = stage_mod.apply(
                 {"params": params["blocks"]},
-                (h_in.astype(dtype), jnp.zeros((), jnp.float32)),
+                carry_in,
                 rngs={"dropout": mrng},
             )
             mb_done = t - (n_stages - 1)  # microbatch finishing at the tail
