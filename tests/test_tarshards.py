@@ -65,7 +65,11 @@ class TestExpansion:
     def test_index_file_with_comments(self, tmp_path):
         idx = tmp_path / "train.index"
         idx.write_text("# comment\n\ngs://b/x-{00..01}.tar.gz\nlocal.tar\n")
-        assert read_index(idx) == ["gs://b/x-00.tar.gz", "gs://b/x-01.tar.gz", "local.tar"]
+        # relative local entries resolve against the index's own directory
+        # (relocatable datasets); URLs pass through verbatim
+        assert read_index(idx) == [
+            "gs://b/x-00.tar.gz", "gs://b/x-01.tar.gz", str(tmp_path / "local.tar")
+        ]
 
     def test_empty_index_raises(self, tmp_path):
         idx = tmp_path / "empty.index"

@@ -144,9 +144,7 @@ def write_tar_shards(
     try:
         for i, row in enumerate(rows):
             if tar is None:
-                # absolute: the .index must resolve from any cwd, not just
-                # the directory prepare happened to run in
-                shard_path = Path(f"{out_prefix}-{len(shards):05d}.tar.gz").resolve()
+                shard_path = Path(f"{out_prefix}-{len(shards):05d}.tar.gz")
                 tar = tarfile.open(shard_path, "w:gz")
                 shards.append(shard_path)
                 in_shard = 0
@@ -164,7 +162,10 @@ def write_tar_shards(
         if tar is not None:
             tar.close()
     index = Path(f"{out_prefix}.index")
-    index.write_text("".join(f"{s}\n" for s in shards))
+    # entries are shard FILENAMES: read_index resolves relative entries
+    # against the index's own directory, so the dataset directory can be
+    # moved/copied wholesale and the index keeps working
+    index.write_text("".join(f"{s.name}\n" for s in shards))
     return shards
 
 

@@ -61,13 +61,23 @@ def expand_braces(pattern: str) -> List[str]:
 
 
 def read_index(path: str | Path) -> List[str]:
-    """Index file → expanded shard list (reference ``data/index/*.index``)."""
+    """Index file → expanded shard list (reference ``data/index/*.index``).
+
+    Relative local entries resolve against the index file's OWN directory —
+    an index written next to its shards keeps working after the dataset
+    directory is moved/copied, and is independent of the training job's
+    cwd. Absolute paths and remote URLs (``gs://…``) pass through verbatim.
+    """
+    base = Path(path).parent
     shards: List[str] = []
     for line in Path(path).read_text().splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        shards.extend(expand_braces(line))
+        for s in expand_braces(line):
+            if "://" not in s and not Path(s).is_absolute():
+                s = str(base / s)
+            shards.append(s)
     if not shards:
         raise ValueError(f"index {path} lists no shards")
     return shards

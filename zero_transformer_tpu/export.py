@@ -12,6 +12,7 @@ Usage:
   python -m zero_transformer_tpu.export extract  --checkpoint-dir ckpts [--step N] --out params.msgpack
   python -m zero_transformer_tpu.export extend   --params params.msgpack --layers 24 --out big.msgpack
   python -m zero_transformer_tpu.export inspect  --params params.msgpack
+  python -m zero_transformer_tpu.export import-reference --params ref.msgpack --model 1_3b --out ours.msgpack
 """
 from __future__ import annotations
 
@@ -20,6 +21,72 @@ from pathlib import Path
 
 import jax
 import numpy as np
+
+# Leaf renaming per reference block (reference ``src/models/GPT.py:16-50``
+# auto-names its submodules; ``layers.py`` Dense layers are all
+# use_bias=False, LayerNorms scale-only, qkv kernels share our [in, (head,
+# head_dim)] channel order, so conversion is a pure rename + per-layer
+# stack). Its key-position-only ALiBi bias differs from ours by a per-query
+# constant, which softmax cancels — the converted model computes the same
+# function.
+_REF_BLOCK_MAP = {
+    ("LayerNorm_0", "scale"): ("ln_attn", "scale"),
+    ("LayerNorm_1", "scale"): ("ln_mlp", "scale"),
+    ("CausalAttention_0", "query_proj", "kernel"): ("attn", "query", "kernel"),
+    ("CausalAttention_0", "key_proj", "kernel"): ("attn", "key", "kernel"),
+    ("CausalAttention_0", "value_proj", "kernel"): ("attn", "value", "kernel"),
+    ("CausalAttention_0", "residual_out", "kernel"): ("attn", "out", "kernel"),
+    ("MLPBlock_0", "fc_in", "kernel"): ("mlp", "wi", "kernel"),
+    ("MLPBlock_0", "fc_residual", "kernel"): ("mlp", "wo", "kernel"),
+}
+
+
+def convert_reference_params(ref: dict, scan_layers: bool = True) -> dict:
+    """Reference (fattorib/ZeRO-transformer) param tree -> this framework's.
+
+    ``ref`` is the nested dict from the reference's extracted-params msgpack
+    (``torch_compatability/extract_msgpack.py``); an outer ``params`` wrapper
+    is tolerated. Every reference leaf must be consumed and every expected
+    leaf present — unknown or missing names raise instead of silently
+    dropping weights.
+    """
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    ref = dict(ref.get("params", ref))
+    block_keys = sorted(
+        (k for k in ref if k.startswith("TransformerBlock_")),
+        key=lambda s: int(s.rsplit("_", 1)[1]),
+    )
+    if not block_keys:
+        raise ValueError("no TransformerBlock_* entries: not a reference params tree")
+    expected_top = set(block_keys) | {"wte", "LayerNorm_0"}
+    unknown = set(ref) - expected_top
+    if unknown:
+        raise ValueError(f"unrecognized reference entries: {sorted(unknown)}")
+
+    out = {
+        ("wte", "embedding"): np.asarray(ref["wte"]["embedding"]),
+        ("ln_f", "scale"): np.asarray(ref["LayerNorm_0"]["scale"]),
+    }
+    stacked: dict = {dst: [] for dst in _REF_BLOCK_MAP.values()}
+    for bk in block_keys:
+        flat = flatten_dict(ref[bk])
+        extra = set(flat) - set(_REF_BLOCK_MAP)
+        missing = set(_REF_BLOCK_MAP) - set(flat)
+        if extra or missing:
+            raise ValueError(
+                f"{bk}: unrecognized leaves {sorted(extra)} / missing {sorted(missing)}"
+            )
+        for src, dst in _REF_BLOCK_MAP.items():
+            stacked[dst].append(np.asarray(flat[src]))
+    if scan_layers:
+        for dst, arrs in stacked.items():
+            out[("blocks",) + dst] = np.stack(arrs)
+    else:
+        for dst, arrs in stacked.items():
+            for i, a in enumerate(arrs):
+                out[(f"block_{i}",) + dst] = a
+    return unflatten_dict(out)
 
 
 def _cmd_extract(args) -> None:
@@ -72,6 +139,46 @@ def _cmd_upcycle(args) -> None:
     print(f"upcycled dense -> {args.experts} experts -> {args.out}")
 
 
+def _cmd_import_reference(args) -> None:
+    import jax.numpy as jnp
+    from flax.serialization import msgpack_restore, msgpack_serialize
+
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.models import Transformer
+    from zero_transformer_tpu.parallel.sharding import unbox
+
+    ref = msgpack_restore(Path(args.params).read_bytes())
+    cfg = model_config(args.model)
+    params = convert_reference_params(ref, scan_layers=cfg.scan_layers)
+
+    # validate every leaf against the target architecture's init shapes —
+    # a wrong --model (depth, width, vocab) fails HERE, not at load time
+    shapes = jax.eval_shape(
+        lambda r: Transformer(cfg).init(r, jnp.zeros((1, 8), jnp.int32)),
+        jax.random.PRNGKey(0),
+    )["params"]
+    shapes = unbox(shapes)
+    flat_got = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_want = dict(jax.tree_util.tree_flatten_with_path(shapes)[0])
+    for path, leaf in flat_got:
+        want = flat_want.get(path)
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if want is None:
+            raise SystemExit(f"converted leaf {name} not in {args.model} params")
+        if tuple(want.shape) != tuple(leaf.shape):
+            raise SystemExit(
+                f"{name}: shape {tuple(leaf.shape)} != {args.model}'s {tuple(want.shape)}"
+            )
+    missing = set(flat_want) - {p for p, _ in flat_got}
+    if missing:
+        names = sorted("/".join(str(getattr(k, 'key', k)) for k in m) for m in missing)
+        raise SystemExit(f"{args.model} params missing from conversion: {names}")
+
+    Path(args.out).write_bytes(msgpack_serialize(params))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    print(f"converted {n:,} reference params ({args.model}) -> {args.out}")
+
+
 def _cmd_inspect(args) -> None:
     from zero_transformer_tpu.checkpoint import import_params_msgpack
     from zero_transformer_tpu.utils.surgery import is_stacked, num_layers
@@ -116,6 +223,17 @@ def main(argv=None) -> None:
     ins = sub.add_parser("inspect", help="list tensors in a params msgpack")
     ins.add_argument("--params", required=True)
     ins.set_defaults(fn=_cmd_inspect)
+
+    ir = sub.add_parser(
+        "import-reference",
+        help="reference (fattorib/ZeRO-transformer) params msgpack -> this "
+             "framework's layout, shape-validated against a zoo model",
+    )
+    ir.add_argument("--params", required=True,
+                    help="the reference's extracted-params msgpack")
+    ir.add_argument("--model", required=True, help="target zoo name")
+    ir.add_argument("--out", required=True)
+    ir.set_defaults(fn=_cmd_import_reference)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -46,6 +46,27 @@ def _dense(features: int, axes: Tuple, std: float, dtype, param_dtype, name: str
     )
 
 
+def doc_ids_from_tokens(x: jax.Array, sep_token: int) -> jax.Array:
+    """[B, T] tokens -> [B, T] document ids for packed-sequence masking.
+
+    The separator closes its own document (exclusive cumsum): the sep token
+    attends within the doc it terminates, the token after it starts a fresh
+    segment. ONE rule shared by the fused model and the pipeline engine —
+    they must never diverge (the pipeline trajectory test pins this)."""
+    is_sep = (x == sep_token).astype(jnp.int32)
+    return jnp.cumsum(is_sep, axis=1) - is_sep
+
+
+def mask_boundary_labels(labels: jax.Array, doc_ids: jax.Array) -> jax.Array:
+    """Set labels to -1 (the loss ignore_index) where the document changes:
+    never predict the first token of the NEXT document from the previous
+    one. Shared by the fused model and the pipeline engine."""
+    boundary = doc_ids[:, 1:] != doc_ids[:, :-1]
+    return jnp.concatenate(
+        [labels[:, :1], jnp.where(boundary, -1, labels[:, 1:])], axis=1
+    )
+
+
 def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Symmetric int8 quantization over the head dim: [B, T, KVH, D] ->
     (int8 values, f32 scale [B, T, KVH, 1]). Round-to-nearest; scale floored
@@ -320,12 +341,9 @@ class Transformer(nn.Module):
         packed = cfg.doc_sep_token is not None and not self.decode
         doc_ids = None
         if packed:
-            # the separator closes its own document (exclusive cumsum): the
-            # sep token attends within the doc it terminates, the token
-            # after it starts a fresh segment. Composes with ring attention
-            # too (the kv doc ids ride the ppermute ring).
-            is_sep = (x == cfg.doc_sep_token).astype(jnp.int32)
-            doc_ids = jnp.cumsum(is_sep, axis=1) - is_sep
+            # composes with ring attention too (the kv doc ids ride the
+            # ppermute ring)
+            doc_ids = doc_ids_from_tokens(x, cfg.doc_sep_token)
         carry = (h, aux, doc_ids) if packed else (h, aux)
         if cfg.scan_layers:
             stack = nn.scan(
@@ -356,12 +374,7 @@ class Transformer(nn.Module):
         if labels is None:
             return logits
         if packed:
-            # never predict the first token of the NEXT document from the
-            # previous one: where the segment id changes, ignore the target
-            boundary = doc_ids[:, 1:] != doc_ids[:, :-1]
-            labels = jnp.concatenate(
-                [labels[:, :1], jnp.where(boundary, -1, labels[:, 1:])], axis=1
-            )
+            labels = mask_boundary_labels(labels, doc_ids)
             loss = next_token_loss(logits, labels, ignore_index=-1)
         else:
             loss = next_token_loss(logits, labels)

@@ -64,7 +64,13 @@ def make_pp_train_step(
     explicit collective core in ``zero.py``, which cannot nest inside the
     pipe-manual region.
     """
-    from zero_transformer_tpu.models.gpt import Block, _dense, _norm
+    from zero_transformer_tpu.models.gpt import (
+        Block,
+        _dense,
+        _norm,
+        doc_ids_from_tokens,
+        mask_boundary_labels,
+    )
     from zero_transformer_tpu.parallel.mesh import TENSOR_AXIS
     from zero_transformer_tpu.parallel.zero import TrainState
 
@@ -144,11 +150,10 @@ def make_pp_train_step(
         def ids_mb(i):
             # every rank holds the full (pipe-replicated) batch, so the
             # packed-document ids need not ride the stage carry hops — each
-            # rank derives them for whatever microbatch it is working on
-            # (same exclusive-cumsum rule as models/gpt.py)
+            # rank derives them for whatever microbatch it is working on,
+            # with the ONE shared rule (models/gpt.py doc_ids_from_tokens)
             x = batch[jnp.clip(i, 0, M - 1)]
-            is_sep = (x == cfg.doc_sep_token).astype(jnp.int32)
-            return jnp.cumsum(is_sep, axis=1) - is_sep
+            return doc_ids_from_tokens(x, cfg.doc_sep_token)
 
         def head_loss_mb(h, i):
             x = batch[jnp.clip(i, 0, M - 1)]
@@ -160,13 +165,7 @@ def make_pp_train_step(
             else:
                 logits = head_mod.apply({"params": params["lm_head"]}, h)
             if packed:
-                # never predict the first token of the NEXT document
-                # (models/gpt.py boundary masking, verbatim semantics)
-                ids = ids_mb(i)
-                boundary = ids[:, 1:] != ids[:, :-1]
-                labels = jnp.concatenate(
-                    [x[:, :1], jnp.where(boundary, -1, x[:, 1:])], axis=1
-                )
+                labels = mask_boundary_labels(x, ids_mb(i))
                 return next_token_loss(logits, labels, ignore_index=-1)
             return next_token_loss(logits, x)
 
