@@ -228,7 +228,7 @@ def child_decode() -> dict:
     np.asarray(out)
     dt = (time.perf_counter() - t0) / reps
 
-    return {
+    result = {
         "ok": True,
         "platform": platform,
         "model": model_name,
@@ -241,6 +241,48 @@ def child_decode() -> dict:
         "compile_seconds": round(t_compile, 1),
         "note": "wall time includes one prefill per rep",
     }
+
+    # batch-1 latency path: prompt-lookup speculative vs plain greedy on a
+    # self-similar prompt (the regime speculation exists for)
+    spec_k = int(os.environ.get("BENCH_DECODE_SPEC", "8"))
+    if spec_k > 0:
+        from zero_transformer_tpu.inference.generate import (
+            decode_model as build_decode_model,
+            generate as gen,
+        )
+        from zero_transformer_tpu.inference.speculative import generate_speculative
+
+        piece = jax.random.randint(jax.random.PRNGKey(7), (32,), 0, cfg.vocab_size, jnp.int32)
+        rep_prompt = jnp.tile(piece, 4)[None, :]  # [1, 128] periodic
+        # the speculative scratch needs prompt + new + K cache slots — the
+        # batch model above was sized without the K slack
+        model = build_decode_model(cfg, rep_prompt.shape[1] + new + spec_k)
+        greedy = SamplingConfig(greedy=True)
+
+        def timed(fn, reps=3):
+            out = fn()
+            np.asarray(out)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn()
+            np.asarray(out)
+            return (time.perf_counter() - t0) / reps
+
+        t_plain = timed(lambda: gen(model, params, rep_prompt, new,
+                                    jax.random.PRNGKey(0), greedy))
+        spec_out, stats = generate_speculative(
+            model, params, rep_prompt, new, draft_len=spec_k, return_stats=True
+        )
+        t_spec = timed(lambda: generate_speculative(
+            model, params, rep_prompt, new, draft_len=spec_k))
+        result["speculative"] = {
+            "draft_len": spec_k,
+            "plain_tok_s": round(new / t_plain, 1),
+            "spec_tok_s": round(new / t_spec, 1),
+            "speedup": round(t_plain / t_spec, 2),
+            "tokens_per_forward": round(stats["tokens_per_forward"], 2),
+        }
+    return result
 
 
 def child_loader() -> dict:

@@ -158,3 +158,24 @@ def test_generator_with_byte_tokenizer():
     # decode exactly (the _decode cleanup pinning exists for this invariant)
     streamed = "".join(gen.stream("hi", max_new_tokens=8, greedy=True))
     assert streamed == out
+
+
+def test_speculative_serve_matches_plain(generator):
+    """--speculative K must not change output. Pure greedy (repetition
+    penalty off) routes through the speculative engine; greedy WITH the
+    penalty (serve's default 1.1 — it changes the argmax trajectory) and the
+    sampled path must both fall back to the plain loop."""
+    spec_gen = TextGenerator(
+        generator.cfg, generator.params, generator.tokenizer,
+        cache_len=generator.cache_len, speculative=4,
+    )
+    kw = dict(max_new_tokens=12, greedy=True, repetition_penalty=1.0)
+    assert spec_gen("hello there", **kw) == generator("hello there", **kw)
+    # penalty active: both must take the plain path (identical by fallback)
+    a = generator("hello there", max_new_tokens=12, greedy=True)
+    b = spec_gen("hello there", max_new_tokens=12, greedy=True)
+    assert a == b
+    # sampled path: same seed, speculative flag irrelevant
+    a = generator("abc", max_new_tokens=6, greedy=False, seed=3)
+    b = spec_gen("abc", max_new_tokens=6, greedy=False, seed=3)
+    assert a == b

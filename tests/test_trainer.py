@@ -238,3 +238,37 @@ def test_warm_init_msgpack_with_depth_extension(tmp_path, devices):
     params_equal(got["block_3"], donor_blocks["block_1"])
     params_equal(got["wte"], donor_params["wte"])
     trainer.close()
+
+
+def test_sigterm_preemption_checkpoints_and_stops(tmp_path, devices):
+    """SIGTERM mid-run: the trainer finishes the current step, force-saves a
+    checkpoint, and exits the loop early — the preemption handling the
+    reference lacked (its only recovery was rerun --resume from the last
+    periodic save). Resuming afterwards continues from the preempted step."""
+    import os
+    import signal
+    import threading
+
+    cfg = tiny_config(tmp_path, total_steps=5000, data=structured_data(tmp_path))
+    trainer = Trainer(cfg)
+    # fire SIGTERM shortly after the loop starts compiling/stepping
+    timer = threading.Timer(3.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        state = trainer.train()
+    finally:
+        timer.cancel()
+    stopped_at = int(state.step)
+    assert 0 < stopped_at < 5000, "SIGTERM did not stop the loop early"
+    assert stopped_at in trainer.ckpt.all_steps(), (
+        stopped_at, trainer.ckpt.all_steps()
+    )
+    trainer.close()
+    # the handler must have been restored (a second train() run would
+    # otherwise inherit a stale flag); resume picks up at the saved step
+    cfg2 = dataclasses.replace(cfg, checkpoint=dataclasses.replace(
+        cfg.checkpoint, resume=True))
+    t2 = Trainer(cfg2)
+    s2 = t2.init_state()
+    assert int(s2.step) == stopped_at
+    t2.close()

@@ -272,3 +272,38 @@ def test_ulysses_with_remat_zero3_trains_llama_shapes(devices):
         losses.append(float(metrics["loss"]))
         assert np.isfinite(losses[-1]) and np.isfinite(float(metrics["grad_norm"]))
     assert losses[-1] < losses[0] - 0.5, f"no learning under ulysses+zero3: {losses}"
+
+
+def test_ulysses_step_compiles_to_all_to_all(devices):
+    """The compiled HLO of a cp_impl=ulysses train step must contain
+    all-to-all collectives (the engine's defining reshard) — and the ring
+    engine's compiled step must contain collective-permute instead. Guards
+    against either engine silently degrading to all-gather materialization."""
+    from zero_transformer_tpu.config import OptimizerConfig
+    from zero_transformer_tpu.parallel import (
+        init_train_state, make_plan, make_train_step,
+    )
+    from zero_transformer_tpu.training.optimizer import make_optimizer
+
+    mesh = make_mesh(MeshConfig(data=2, sequence=4))
+    opt = OptimizerConfig(peak_learning_rate=1e-3, warmup_steps=2, total_steps=40)
+    tx = make_optimizer(opt)
+    batch = jnp.zeros((1, 4, 32), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    def hlo_for(cp_impl):
+        cfg = ModelConfig(
+            name=f"hlo_{cp_impl}", vocab_size=64, d_model=32, n_heads=4,
+            n_layers=2, max_seq_len=32, dropout=0.0, cp_impl=cp_impl,
+        )
+        model = Transformer(cfg, mesh=mesh)
+        plan = make_plan(model, tx, mesh, (4, 32), zero_stage=1)
+        state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, (4, 32), plan)
+        step = make_train_step(model, tx, mesh, plan, 1)
+        return step.lower(state, batch, rng).compile().as_text()
+
+    uly = hlo_for("ulysses")
+    assert "all-to-all" in uly, "no all-to-all in compiled ulysses step"
+    ring = hlo_for("ring")
+    assert "collective-permute" in ring, "no ppermute in compiled ring step"
+    assert "all-to-all" not in ring

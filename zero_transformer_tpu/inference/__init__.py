@@ -1,6 +1,7 @@
 """In-tree TPU inference: KV-cache decode + sampling (replaces the
 reference's CUDA/PyTorch side-car, reference ``torch_compatability/`` +
 ``app.py``)."""
+from zero_transformer_tpu.inference.speculative import generate_speculative
 from zero_transformer_tpu.inference.generate import (
     decode_model,
     generate,
@@ -23,6 +24,7 @@ __all__ = [
     "apply_repetition_penalty",
     "decode_model",
     "generate",
+    "generate_speculative",
     "generate_tokens",
     "init_cache",
     "prefill",

@@ -1,0 +1,197 @@
+"""Self-speculative decoding via prompt lookup (no draft model).
+
+Decode on TPU is HBM-bandwidth-bound: a 1-token step and a (K+1)-token step
+read the same weight bytes, so verifying K drafted tokens in ONE cached
+forward multiplies throughput by the acceptance length. The drafts come from
+the sequence's own history — the K tokens that followed the most recent
+earlier occurrence of the current bigram ("prompt lookup decoding") — which
+is free and surprisingly accurate on the repetitive text that dominates
+summarization/extraction/code serving. The reference has nothing comparable
+(its decode is a per-token Python loop, ``app.py:69-94``).
+
+Exactness contract: greedy speculative output is IDENTICAL to greedy
+one-token-at-a-time decode — acceptance keeps a drafted token only when it
+equals the model's own argmax given the verified prefix, so the emitted
+sequence is the plain greedy sequence by construction (tested).
+
+Mechanics (one ``lax.while_loop``, all shapes static):
+- carry the confirmed history ``hist`` and the newest confirmed-but-uncached
+  token ``c0``;
+- draft = the K tokens after the latest earlier occurrence of
+  ``(hist[cur-1], c0)``;
+- one cached forward on ``[c0, draft…]`` writes K+1 cache slots at offset
+  ``cur`` and yields argmaxes ``y``; the accepted prefix is the run of
+  ``draft[j] == y[j]``;
+- emit accepted drafts + the correction token ``y[n_acc]``, rewind the cache
+  index to ``cur + n_acc + 1`` (stale slots beyond it are masked by the
+  validity mask and overwritten by the next iteration's writes).
+
+Batch 1 only: per-row acceptance lengths would need per-row cache offsets,
+which the fixed-shape cache does not support — and batch-1 latency is
+exactly where speculation matters (the serve REPL case).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zero_transformer_tpu.inference.generate import init_cache, prefill
+from zero_transformer_tpu.models.gpt import Transformer
+
+
+def _set_cache_index(cache: Any, value: jax.Array) -> Any:
+    """Overwrite every ``cache_index`` leaf (scalar per layer; [L] when the
+    layer stack is scanned) with ``value`` — the cache rewind primitive."""
+
+    def one(path, leaf):
+        if any(getattr(k, "key", None) == "cache_index" for k in path):
+            return jnp.full(leaf.shape, value, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, cache)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _spec_loop(
+    model: Transformer,
+    max_new: int,
+    K: int,
+    eos_token_id: int,
+    pad_token_id: int,
+    params: Any,
+    hist0: jax.Array,  # [hist_len] int32: prompt then zeros
+    t0: jax.Array,  # scalar: prompt length
+    c0_init: jax.Array,  # scalar: first greedy token (already emitted)
+    cache: Any,
+):
+    hist_len = hist0.shape[0]
+    out_len = max_new + K + 1  # slack for the fixed-size block writes
+    out0 = jnp.full((out_len,), pad_token_id, jnp.int32)
+    out0 = out0.at[0].set(c0_init)
+    hist0 = jax.lax.dynamic_update_slice(hist0, c0_init[None], (t0,))
+    done0 = (eos_token_id >= 0) & (c0_init == eos_token_id)
+
+    def cond(carry):
+        _, _, _, _, _, out_pos, done, _ = carry
+        return (out_pos < max_new) & ~done
+
+    def body(carry):
+        c0, hist, cur, cache, out, out_pos, done, n_fwd = carry
+        # ---- draft: K tokens after the latest earlier (prev, c0) bigram
+        prev = hist[cur - 1]
+        pos = jnp.arange(hist_len - 1)
+        match = (hist[:-1] == prev) & (hist[1:] == c0) & (pos < cur - 1)
+        has_match = jnp.any(match)
+        p = jnp.argmax(jnp.where(match, pos, -1))
+        start = jnp.where(has_match, p + 2, 0).astype(jnp.int32)
+        draft = jax.lax.dynamic_slice(hist, (start,), (K,))
+
+        # ---- one cached forward over [c0, draft...]; KV written at cur
+        x_in = jnp.concatenate([c0[None], draft])[None]  # [1, K+1]
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache}, x_in, mutable=["cache"]
+        )
+        cache = vars_out["cache"]
+        y = jnp.argmax(logits[0].astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+        # ---- accepted prefix + correction token
+        ok = (draft == y[:K]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(ok))
+        j = jnp.arange(K + 1)
+        block = jnp.where(j == n_acc, y[n_acc], jnp.concatenate([draft, y[-1:]]))
+        n_emit = n_acc + 1
+        if eos_token_id >= 0:
+            hit = (block == eos_token_id) & (j < n_emit)
+            first = jnp.argmax(hit)  # first True (0 if none — gated by any)
+            n_emit = jnp.where(jnp.any(hit), first + 1, n_emit)
+            done = done | jnp.any(hit)
+
+        # ---- commit: out, hist, cache index rewind
+        out = jax.lax.dynamic_update_slice(out, block, (out_pos,))
+        hist = jax.lax.dynamic_update_slice(hist, block, (cur + 1,))
+        cache = _set_cache_index(cache, (cur + n_acc + 1).astype(jnp.int32))
+        out_pos = out_pos + n_emit
+        done = done | (out_pos >= max_new)
+        return (
+            block[n_emit - 1], hist, cur + n_emit, cache, out, out_pos, done,
+            n_fwd + 1,
+        )
+
+    carry = (
+        c0_init.astype(jnp.int32), hist0, t0.astype(jnp.int32), cache, out0,
+        jnp.asarray(1, jnp.int32), done0, jnp.asarray(0, jnp.int32),
+    )
+    c0, hist, cur, cache, out, out_pos, done, n_fwd = jax.lax.while_loop(
+        cond, body, carry
+    )
+    valid = jnp.arange(out_len) < out_pos
+    out = jnp.where(valid, out, pad_token_id)[:max_new]
+    # rows past an early EOS are pad (mirror generate()'s contract)
+    if eos_token_id >= 0:
+        hit = out == eos_token_id
+        after = jnp.cumsum(hit) - hit.astype(jnp.int32) > 0
+        out = jnp.where(after, pad_token_id, out)
+    return out[None, :], n_fwd, jnp.minimum(out_pos, max_new)
+
+
+def generate_speculative(
+    model: Transformer,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    draft_len: int = 8,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    return_stats: bool = False,
+) -> jax.Array | Tuple[jax.Array, dict]:
+    """Greedy prompt-lookup speculative decode. prompt [1, T] int32.
+
+    Returns [1, max_new_tokens] int32 — identical to
+    ``generate(..., SamplingConfig(greedy=True))`` by construction, in fewer
+    model forwards on self-similar text. ``return_stats`` adds
+    ``{"forwards": n, "tokens_per_forward": ...}``.
+    """
+    B, T0 = prompt.shape
+    if B != 1:
+        raise ValueError("speculative decoding supports batch=1 (serve latency path)")
+    K = int(draft_len)
+    if K < 1:
+        raise ValueError("draft_len must be >= 1")
+    cache_len = model.cache_len or model.cfg.max_seq_len
+    # worst case writes K+1 slots starting at T0 + max_new - 1
+    if T0 + max_new_tokens + K > cache_len:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) + draft_len "
+            f"({K}) exceeds cache_len ({cache_len})"
+        )
+    if model.cfg.position == "learned" and T0 + max_new_tokens > model.cfg.max_seq_len:
+        # same guard as generate(): the wpe table cannot extrapolate and the
+        # gather would silently clamp — breaking the exact-greedy contract
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({model.cfg.max_seq_len}) and learned positions "
+            "cannot extrapolate (use position='alibi' or 'rope')"
+        )
+    cache = init_cache(model, 1)
+    last_logits, cache = prefill(model, params, prompt, cache)
+    c0 = jnp.argmax(last_logits[0].astype(jnp.float32)).astype(jnp.int32)
+
+    hist_len = T0 + max_new_tokens + K + 2
+    hist = jnp.zeros((hist_len,), jnp.int32)
+    hist = jax.lax.dynamic_update_slice(hist, prompt[0], (0,))
+    out, n_fwd, n_emitted = _spec_loop(
+        model, int(max_new_tokens), K,
+        -1 if eos_token_id is None else int(eos_token_id), int(pad_token_id),
+        params, hist, jnp.asarray(T0, jnp.int32), c0, cache,
+    )
+    if return_stats:
+        stats = {
+            "forwards": int(n_fwd) + 1,  # + prefill's last-position logits
+            "tokens_per_forward": int(n_emitted) / (int(n_fwd) + 1),
+        }
+        return out, stats
+    return out

@@ -57,7 +57,8 @@ def _load_tokenizer(name_or_path: str):
 class TextGenerator:
     """Tokenizer + params + compiled decode loop behind one ``__call__``."""
 
-    def __init__(self, cfg, params: Any, tokenizer, cache_len: Optional[int] = None):
+    def __init__(self, cfg, params: Any, tokenizer, cache_len: Optional[int] = None,
+                 speculative: int = 0):
         from zero_transformer_tpu.inference import decode_model
 
         self.cfg = cfg
@@ -65,6 +66,9 @@ class TextGenerator:
         self.cache_len = cache_len or cfg.max_seq_len
         self.model = decode_model(cfg, self.cache_len)
         self.params = params
+        # draft length for prompt-lookup speculative decoding (greedy one-shot
+        # generation only; 0 = off)
+        self.speculative = speculative
 
     def _decode(self, toks) -> str:
         """Detokenize WITHOUT clean_up_tokenization_spaces: the cleanup pass
@@ -93,6 +97,23 @@ class TextGenerator:
             prompt, max_new_tokens, temperature, top_k, top_p,
             repetition_penalty, greedy,
         )
+        # draft scratch must fit the cache (prompt + new + K); shrink K to
+        # whatever fits rather than erroring at the budget edge
+        spec_k = min(self.speculative, self.cache_len - len(ids) - max_new_tokens)
+        # speculation is PURE argmax. temperature / top-k / top-p never
+        # change the argmax (monotone or top-token-preserving), but the
+        # repetition penalty does — with it active the speculative and
+        # plain greedy trajectories diverge, so fall back to the plain loop
+        if spec_k > 0 and greedy and repetition_penalty == 1.0:
+            from zero_transformer_tpu.inference import generate_speculative
+
+            out = generate_speculative(
+                self.model, self.params, jnp.asarray([ids], jnp.int32),
+                max_new_tokens, draft_len=spec_k,
+                eos_token_id=eos, pad_token_id=eos if eos is not None else 0,
+            )
+            toks = [t for t in out[0].tolist() if t != eos]
+            return self._decode(toks)
         out = generate(
             self.model,
             self.params,
@@ -180,7 +201,10 @@ def _build_generator(args) -> TextGenerator:
     params = import_params_msgpack(args.params)
     params = jax.tree.map(jnp.asarray, params)
     tokenizer = _load_tokenizer(args.tokenizer)
-    return TextGenerator(cfg, params, tokenizer, cache_len=args.cache_len)
+    return TextGenerator(
+        cfg, params, tokenizer, cache_len=args.cache_len,
+        speculative=args.speculative,
+    )
 
 
 def _repl(gen: TextGenerator, args) -> None:
@@ -250,6 +274,12 @@ def main(argv=None) -> None:
                    help="int8 halves KV-cache HBM traffic (doubles servable "
                         "context) at slight quantization cost")
     p.add_argument("--cache-len", type=int, default=None)
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="prompt-lookup speculative decoding with K-token "
+                        "drafts (greedy one-shot generation with "
+                        "--repetition-penalty 1.0 only — the penalty "
+                        "changes the argmax trajectory; exact same output, "
+                        "fewer model forwards)")
     p.add_argument("--prompt", default=None, help="one-shot generation")
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--temperature", type=float, default=0.8)
