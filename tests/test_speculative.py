@@ -102,3 +102,20 @@ def test_speculative_learned_positions_guard():
     prompt = jnp.zeros((1, 10), jnp.int32)
     with pytest.raises(ValueError, match="extrapolate"):
         generate_speculative(model, params, prompt, 30, draft_len=4)
+
+
+def test_speculative_with_int8_kv_cache():
+    """Speculation composes with the int8 KV cache: both paths run the same
+    quantized model, so greedy equivalence must hold there too (the cache
+    rewind must not corrupt the scale slots)."""
+    cfg = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    model, params = _model_and_params(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(1, 64, (1, 12)), jnp.int32
+    )
+    plain = generate(
+        model, params, prompt, 32, jax.random.PRNGKey(0),
+        SamplingConfig(greedy=True),
+    )
+    spec = generate_speculative(model, params, prompt, 32, draft_len=4)
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
