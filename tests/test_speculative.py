@@ -45,6 +45,25 @@ def test_speculative_equals_plain_greedy(position, draft_len):
     np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
 
 
+@pytest.mark.parametrize("penalty", [1.1, 1.5])
+def test_speculative_equals_greedy_with_repetition_penalty(penalty):
+    """The penalty changes the argmax trajectory over time; the acceptance
+    walk must reproduce it exactly (the evolving generated-token mask is
+    threaded through the drafted block)."""
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(1, 64, (1, 12)), jnp.int32
+    )
+    plain = generate(
+        model, params, prompt, 40, jax.random.PRNGKey(0),
+        SamplingConfig(greedy=True, repetition_penalty=penalty),
+    )
+    spec = generate_speculative(
+        model, params, prompt, 40, draft_len=4, repetition_penalty=penalty
+    )
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
+
+
 def test_speculative_eos_and_padding():
     model, params = _model_and_params()
     prompt = jnp.asarray(

@@ -54,20 +54,23 @@ def _set_cache_index(cache: Any, value: jax.Array) -> Any:
     return jax.tree_util.tree_map_with_path(one, cache)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _spec_loop(
     model: Transformer,
     max_new: int,
     K: int,
     eos_token_id: int,
     pad_token_id: int,
+    penalty: float,  # repetition penalty (1.0 = off; emulated in acceptance)
     params: Any,
     hist0: jax.Array,  # [hist_len] int32: prompt then zeros
     t0: jax.Array,  # scalar: prompt length
     c0_init: jax.Array,  # scalar: first greedy token (already emitted)
+    gen_mask0: jax.Array,  # [V] bool: generated-token presence (c0 set)
     cache: Any,
 ):
     hist_len = hist0.shape[0]
+    V = gen_mask0.shape[0]
     out_len = max_new + K + 1  # slack for the fixed-size block writes
     out0 = jnp.full((out_len,), pad_token_id, jnp.int32)
     out0 = out0.at[0].set(c0_init)
@@ -75,11 +78,11 @@ def _spec_loop(
     done0 = (eos_token_id >= 0) & (c0_init == eos_token_id)
 
     def cond(carry):
-        _, _, _, _, _, out_pos, done, _ = carry
+        _, _, _, _, _, out_pos, done, _, _ = carry
         return (out_pos < max_new) & ~done
 
     def body(carry):
-        c0, hist, cur, cache, out, out_pos, done, n_fwd = carry
+        c0, hist, cur, cache, out, out_pos, done, n_fwd, gen_mask = carry
         # ---- draft: K tokens after the latest earlier (prev, c0) bigram
         prev = hist[cur - 1]
         pos = jnp.arange(hist_len - 1)
@@ -95,13 +98,53 @@ def _spec_loop(
             {"params": params, "cache": cache}, x_in, mutable=["cache"]
         )
         cache = vars_out["cache"]
-        y = jnp.argmax(logits[0].astype(jnp.float32), axis=-1).astype(jnp.int32)
+        logits32 = logits[0].astype(jnp.float32)  # [K+1, V]
 
         # ---- accepted prefix + correction token
-        ok = (draft == y[:K]).astype(jnp.int32)
-        n_acc = jnp.sum(jnp.cumprod(ok))
-        j = jnp.arange(K + 1)
-        block = jnp.where(j == n_acc, y[n_acc], jnp.concatenate([draft, y[-1:]]))
+        if penalty == 1.0:
+            # pure argmax: acceptance is vectorizable
+            y = jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+            ok = (draft == y[:K]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(ok))
+            j = jnp.arange(K + 1)
+            block = jnp.where(j == n_acc, y[n_acc], jnp.concatenate([draft, y[-1:]]))
+        else:
+            # the repetition penalty makes position j's argmax depend on the
+            # tokens accepted before it, so acceptance walks the block
+            # sequentially with the evolving generated-token mask — exactly
+            # the trajectory the plain loop's sample_token takes (temperature
+            # and top-k/top-p never change the argmax; the penalty does)
+            draft_ext = jnp.concatenate([draft, jnp.full((1,), -1, jnp.int32)])
+            is_last = jnp.arange(K + 1) == K
+
+            from zero_transformer_tpu.inference.sampling import (
+                apply_repetition_penalty,
+            )
+
+            def acc_step(c, inp):
+                mask, accepting, n_acc, corr = c
+                row, d_j, last = inp
+                # the canonical penalty transform (sampling.py) — the
+                # exact-greedy contract requires bit-identical semantics
+                pl = apply_repetition_penalty(row, mask, penalty)
+                yj = jnp.argmax(pl).astype(jnp.int32)
+                take = accepting & ~last & (d_j == yj)
+                new_tok = jnp.where(take, d_j, yj)
+                mask = jnp.where(
+                    accepting, mask | (jnp.arange(V) == new_tok), mask
+                )
+                corr = jnp.where(accepting & ~take, yj, corr)
+                n_acc = n_acc + jnp.where(take, 1, 0)
+                return (mask, take, n_acc, corr), None
+
+            (gen_mask, _, n_acc, corr), _ = jax.lax.scan(
+                acc_step,
+                (gen_mask, jnp.asarray(True), jnp.asarray(0, jnp.int32),
+                 jnp.asarray(0, jnp.int32)),
+                (logits32, draft_ext, is_last),
+            )
+            j = jnp.arange(K + 1)
+            block = jnp.where(j == n_acc, corr, jnp.concatenate([draft, corr[None]]))
         n_emit = n_acc + 1
         if eos_token_id >= 0:
             hit = (block == eos_token_id) & (j < n_emit)
@@ -117,14 +160,14 @@ def _spec_loop(
         done = done | (out_pos >= max_new)
         return (
             block[n_emit - 1], hist, cur + n_emit, cache, out, out_pos, done,
-            n_fwd + 1,
+            n_fwd + 1, gen_mask,
         )
 
     carry = (
         c0_init.astype(jnp.int32), hist0, t0.astype(jnp.int32), cache, out0,
-        jnp.asarray(1, jnp.int32), done0, jnp.asarray(0, jnp.int32),
+        jnp.asarray(1, jnp.int32), done0, jnp.asarray(0, jnp.int32), gen_mask0,
     )
-    c0, hist, cur, cache, out, out_pos, done, n_fwd = jax.lax.while_loop(
+    c0, hist, cur, cache, out, out_pos, done, n_fwd, _ = jax.lax.while_loop(
         cond, body, carry
     )
     valid = jnp.arange(out_len) < out_pos
@@ -146,13 +189,16 @@ def generate_speculative(
     draft_len: int = 8,
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
+    repetition_penalty: float = 1.0,
     return_stats: bool = False,
 ) -> jax.Array | Tuple[jax.Array, dict]:
     """Greedy prompt-lookup speculative decode. prompt [1, T] int32.
 
     Returns [1, max_new_tokens] int32 — identical to
-    ``generate(..., SamplingConfig(greedy=True))`` by construction, in fewer
-    model forwards on self-similar text. ``return_stats`` adds
+    ``generate(..., SamplingConfig(greedy=True, repetition_penalty=p))`` by
+    construction, in fewer model forwards on self-similar text (temperature
+    and top-k/top-p never change the argmax, so greedy with any of those
+    set is also reproduced). ``return_stats`` adds
     ``{"forwards": n, "tokens_per_forward": ...}``.
     """
     B, T0 = prompt.shape
@@ -178,7 +224,11 @@ def generate_speculative(
         )
     cache = init_cache(model, 1)
     last_logits, cache = prefill(model, params, prompt, cache)
+    # first token: nothing generated yet, so the penalty mask is empty and
+    # plain argmax matches the plain loop's first sample exactly
     c0 = jnp.argmax(last_logits[0].astype(jnp.float32)).astype(jnp.int32)
+    V = last_logits.shape[-1]
+    gen_mask0 = jnp.arange(V) == c0
 
     hist_len = T0 + max_new_tokens + K + 2
     hist = jnp.zeros((hist_len,), jnp.int32)
@@ -186,7 +236,8 @@ def generate_speculative(
     out, n_fwd, n_emitted = _spec_loop(
         model, int(max_new_tokens), K,
         -1 if eos_token_id is None else int(eos_token_id), int(pad_token_id),
-        params, hist, jnp.asarray(T0, jnp.int32), c0, cache,
+        float(repetition_penalty),
+        params, hist, jnp.asarray(T0, jnp.int32), c0, gen_mask0, cache,
     )
     if return_stats:
         stats = {

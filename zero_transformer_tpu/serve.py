@@ -98,19 +98,19 @@ class TextGenerator:
             repetition_penalty, greedy,
         )
         # draft scratch must fit the cache (prompt + new + K); shrink K to
-        # whatever fits rather than erroring at the budget edge
+        # whatever fits rather than erroring at the budget edge. temperature
+        # and top-k/top-p never change the argmax, and the repetition
+        # penalty is emulated inside the acceptance walk, so every greedy
+        # configuration routes through speculation.
         spec_k = min(self.speculative, self.cache_len - len(ids) - max_new_tokens)
-        # speculation is PURE argmax. temperature / top-k / top-p never
-        # change the argmax (monotone or top-token-preserving), but the
-        # repetition penalty does — with it active the speculative and
-        # plain greedy trajectories diverge, so fall back to the plain loop
-        if spec_k > 0 and greedy and repetition_penalty == 1.0:
+        if spec_k > 0 and greedy:
             from zero_transformer_tpu.inference import generate_speculative
 
             out = generate_speculative(
                 self.model, self.params, jnp.asarray([ids], jnp.int32),
                 max_new_tokens, draft_len=spec_k,
                 eos_token_id=eos, pad_token_id=eos if eos is not None else 0,
+                repetition_penalty=repetition_penalty,
             )
             toks = [t for t in out[0].tolist() if t != eos]
             return self._decode(toks)
@@ -276,9 +276,8 @@ def main(argv=None) -> None:
     p.add_argument("--cache-len", type=int, default=None)
     p.add_argument("--speculative", type=int, default=0, metavar="K",
                    help="prompt-lookup speculative decoding with K-token "
-                        "drafts (greedy one-shot generation with "
-                        "--repetition-penalty 1.0 only — the penalty "
-                        "changes the argmax trajectory; exact same output, "
+                        "drafts (greedy one-shot generation; exact same "
+                        "output — incl. under the repetition penalty — in "
                         "fewer model forwards)")
     p.add_argument("--prompt", default=None, help="one-shot generation")
     p.add_argument("--max-new-tokens", type=int, default=128)
