@@ -251,13 +251,31 @@ def test_sigterm_preemption_checkpoints_and_stops(tmp_path, devices):
 
     cfg = tiny_config(tmp_path, total_steps=5000, data=structured_data(tmp_path))
     trainer = Trainer(cfg)
-    # fire SIGTERM shortly after the loop starts compiling/stepping
-    timer = threading.Timer(3.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
-    timer.start()
+
+    # fire SIGTERM only once the loop is demonstrably RUNNING (first metrics
+    # line written) — a fixed timer races with compile time on a loaded
+    # machine and can land before the handler is installed, killing pytest
+    stop_poll = threading.Event()
+
+    def fire_when_running():
+        metrics = tmp_path / "run" / "metrics.jsonl"
+        for _ in range(600):  # up to 60s for the first logged step
+            if metrics.exists() and metrics.stat().st_size > 0:
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
+            if stop_poll.wait(0.1):
+                return
+        # even on a pathologically slow machine, fire rather than silently
+        # letting the 5000-step run continue to a misleading failure (the
+        # handler is installed before step 1, long before any logging)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    poller = threading.Thread(target=fire_when_running, daemon=True)
+    poller.start()
     try:
         state = trainer.train()
     finally:
-        timer.cancel()
+        stop_poll.set()
     stopped_at = int(state.step)
     assert 0 < stopped_at < 5000, "SIGTERM did not stop the loop early"
     assert stopped_at in trainer.ckpt.all_steps(), (
