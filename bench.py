@@ -17,14 +17,22 @@ block >300s) is killed and recorded instead of taking the whole capture down
   1. TPU, 580M, remat on    (the memory-safe configuration — runs FIRST so a
      good number always lands before risky upside experiments; round-2 ran
      the OOM-prone remat-off config first and lost the artifact)
-  2. TPU, 580M, remat with the "dots" policy (saves matmul outputs,
+  2. TPU, 1.3B, remat on, adafactor — THE north-star scenario
+     (BASELINE.json metric is "GPT-1.3B tokens/sec/chip"); if it lands it
+     becomes the headline metric/value even though the smaller 580M posts
+     higher raw tok/s, with vs_baseline computed against the per-model
+     baseline table below.
+  3. TPU, 580M, remat with the "dots" policy (saves matmul outputs,
      recomputes only elementwise — faster bwd if it fits)
-  3. TPU, 580M, remat off   (upside experiment; smaller per-step batch so it
+  4. TPU, 580M, remat off   (upside experiment; smaller per-step batch so it
      has a chance of fitting 16 GB v5e HBM, same 64k tokens/step via accum)
-  4. TPU flash-attention microbenchmark sweep T in {1k,4k,8k,16k}
+  5. TPU flash-attention microbenchmark sweep T in {1k,4k,8k,16k}
      (extra; only after a TPU success)
-  5. TPU KV-cache decode throughput (extra; only after a TPU success)
-  6. CPU smoke fallback     (only if every TPU scenario failed)
+  6. TPU KV-cache decode throughput (extra; only after a TPU success)
+  7. CPU smoke fallback     (only if every TPU scenario failed); if every TPU
+     failure was a BACKEND-INIT hang (environment outage, not code), the
+     latest committed measured artifact rides in extra.cached_tpu and the
+     headline carries it, suffixed "_cached".
 
 The parent always exits 0 with exactly ONE parseable JSON line; errors ride
 in ``extra.errors``. Every string embedded in the output is truncated to
@@ -40,6 +48,14 @@ import subprocess
 import sys
 
 BASELINE_TOK_S_CHIP = 4300.0  # reference 580M on TPU v3 (BASELINE.md, derived)
+
+# Per-model reference baselines (tokens/sec/chip, TPU v3-32, derived in
+# BASELINE.md from the reference's training logs). The reference published no
+# 1.3B throughput; its 760M-derived 4.1k/chip is an UPPER bound on what its
+# stack could do at 1.3B (a ~2x larger model is strictly slower per chip at
+# equal efficiency), so vs_baseline for 1_3b is a LOWER bound on the true
+# speedup — conservative, never flattering.
+BASELINES = {"580m": 4300.0, "760m": 4100.0, "1_3b": 4100.0}
 
 MAX_ERR_CHARS = 2048  # hard cap on any string embedded in the output JSON
 MAX_LINE_CHARS = 24_000  # hard cap on the final JSON line itself
@@ -109,6 +125,10 @@ def child_train() -> dict:
     remat_policy = os.environ.get("BENCH_REMAT_POLICY", "none")
     max_steps = int(os.environ.get("BENCH_STEPS", "10"))
     min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", "45"))
+    # "adamw" needs 12 bytes/param of optimizer+master state — too much for
+    # 1.3B on one 16 GB v5e chip. "adafactor" (factored second moment) is how
+    # the 1.3B north-star scenario fits; see training/optimizer.py.
+    optimizer = os.environ.get("BENCH_OPT", "adamw")
 
     platform = jax.default_backend()
     print(f"devices_ok platform={platform} n={jax.device_count()}", file=sys.stderr)
@@ -119,7 +139,9 @@ def child_train() -> dict:
     n_chips = jax.device_count()
     mesh = make_mesh(MeshConfig(zero_stage=1))
     model = Transformer(cfg)
-    tx = make_optimizer(OptimizerConfig(warmup_steps=10, total_steps=1000))
+    tx = make_optimizer(
+        OptimizerConfig(warmup_steps=10, total_steps=1000, optimizer=optimizer)
+    )
 
     sample_shape = (batch_size, seq)
     plan = make_plan(model, tx, mesh, sample_shape, zero_stage=1)
@@ -170,6 +192,7 @@ def child_train() -> dict:
         "compile_seconds": round(t_compile, 1),
         "remat": remat,
         "remat_policy": remat_policy,
+        "optimizer": optimizer,
         "n_chips": n_chips,
         "loss_finite": bool(loss == loss),
         "device_kind": jax.devices()[0].device_kind,
@@ -364,6 +387,58 @@ def child_flash() -> dict:
 # ------------------------------------------------------------------- parent
 
 
+def _cached_tpu_artifact() -> dict | None:
+    """Most recent committed on-chip measurement, for the wedged-tunnel case.
+
+    The axon TPU tunnel can hang at backend init for hours (observed rounds
+    1-3); when that happens the round's official artifact must not read as
+    zero when a committed measured number exists. Looks for, in order:
+    ``BENCH_measured.json`` (canonical latest), newest ``docs/bench/*.json``,
+    newest ``BENCH_r*_measured.json`` (legacy round files). Returns the parsed
+    artifact plus provenance (source path + commit/file timestamp), or None.
+    """
+    import glob
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    candidates = [os.path.join(root, "BENCH_measured.json")]
+    candidates += sorted(glob.glob(os.path.join(root, "docs", "bench", "*.json")), reverse=True)
+    candidates += sorted(glob.glob(os.path.join(root, "BENCH_r*_measured.json")), reverse=True)
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(art, dict) or "value" not in art:
+            continue
+        # never recycle a previous wedged-round output back as a measurement
+        # (provenance would degrade silently with each hop)
+        if str(art.get("metric", "")).endswith("_cached") or art.get("provenance") == "cached":
+            continue
+        ts = art.get("measured_at_utc")
+        if not ts:  # fall back to the commit date of the artifact file
+            try:
+                ts = subprocess.run(
+                    ["git", "log", "-1", "--format=%cI", "--", path],
+                    cwd=root, capture_output=True, text=True, timeout=15,
+                ).stdout.strip() or None
+            except Exception:
+                ts = None
+        return {
+            "provenance": "cached",
+            "source": os.path.relpath(path, root),
+            "measured_at": ts,
+            "metric": art.get("metric"),
+            "value": art.get("value"),
+            "unit": art.get("unit"),
+            "vs_baseline": art.get("vs_baseline"),
+            "mfu": art.get("mfu"),
+        }
+    return None
+
+
 def _run_child(scenario: str, env_extra: dict, timeout: float) -> dict:
     """Run one scenario in a subprocess; parse its final JSON stdout line."""
     env = dict(os.environ)
@@ -436,11 +511,23 @@ def main() -> None:
     upside_timeout = float(os.environ.get("BENCH_UPSIDE_TIMEOUT", "420"))
     for name, env_extra, timeout in (
         ("remat_on", {"BENCH_REMAT": "1"}, tpu_timeout),
+        # THE north-star scenario (BASELINE.json metric: "GPT-1.3B
+        # tokens/sec/chip"): 1.3B params fit one 16 GB v5e chip only with
+        # remat + adafactor (f32 master 5.2 GB + f32 grads 5.2 GB + factored
+        # second moment ~KBs); adamw's 12 bytes/param of state would not.
+        # 64k tokens/step via accumulation, same as the 580m scenario.
+        ("north_star_1_3b",
+         {"BENCH_REMAT": "1", "BENCH_MODEL": "1_3b", "BENCH_OPT": "adafactor",
+          "BENCH_BATCH": "8", "BENCH_ACCUM": "8"}, tpu_timeout),
         # upside experiments, in decreasing fit-probability order
         ("remat_dots", {"BENCH_REMAT": "1", "BENCH_REMAT_POLICY": "dots"}, upside_timeout),
         ("remat_off", {"BENCH_REMAT": "0", "BENCH_BATCH": "4", "BENCH_ACCUM": "16"}, upside_timeout),
     ):
-        res = _run_child("train", env_extra, timeout)
+        if os.environ.get("BENCH_SIMULATE_HUNG") == "1":
+            res = {"ok": False, "error": "simulated: backend init hung",
+                   "backend_init_hung": True}
+        else:
+            res = _run_child("train", env_extra, timeout)
         results[name] = res
         if not res.get("ok"):
             errors.append(_truncate(f"{name}: {res.get('error')}"))
@@ -455,7 +542,11 @@ def main() -> None:
     tpu_good = [r for r in good if r.get("platform") == "tpu"]
 
     if tpu_good:
-        best = max(tpu_good, key=lambda r: r["tok_s_chip"])
+        # headline preference: the 1.3B north-star number if it landed (it is
+        # the BASELINE.json metric, even though the smaller 580m config posts
+        # higher raw tok/s); otherwise the best throughput measured.
+        ns = results.get("north_star_1_3b", {})
+        best = ns if ns.get("ok") else max(tpu_good, key=lambda r: r["tok_s_chip"])
         flash = _run_child("flash", {}, 600.0)
         if not flash.get("ok"):
             errors.append(_truncate(f"flash: {flash.get('error')}"))
@@ -465,11 +556,12 @@ def main() -> None:
         loader = _run_child("loader", {"BENCH_PLATFORM": "cpu"}, 300.0)
         if not loader.get("ok"):
             errors.append(_truncate(f"loader: {loader.get('error')}"))
+        baseline = BASELINES.get(best["model"], BASELINE_TOK_S_CHIP)
         out = {
             "metric": f"train_tokens_per_sec_per_chip_{best['model']}",
             "value": best["tok_s_chip"],
             "unit": "tokens/s/chip",
-            "vs_baseline": round(best["tok_s_chip"] / BASELINE_TOK_S_CHIP, 3),
+            "vs_baseline": round(best["tok_s_chip"] / baseline, 3),
             "mfu": best.get("mfu"),
             "extra": {
                 "scenarios": results,
@@ -512,6 +604,24 @@ def main() -> None:
                 "errors": errors,
             },
         }
+        # Wedged-tunnel mitigation: ONLY when every failed TPU scenario died
+        # at BACKEND INIT (an environment outage, not a code failure) —
+        # surface the latest committed on-chip measurement so the round's
+        # record carries the real number, clearly labeled as cached, instead
+        # of a zero. A single genuine failure (OOM, compile error) among the
+        # results disables this, so a cached number can never mask a real
+        # regression.
+        failed = [r for r in results.values() if not r.get("ok")]
+        hung = bool(failed) and all(r.get("backend_init_hung") for r in failed)
+        cached = _cached_tpu_artifact() if hung else None
+        if cached is not None:
+            out["metric"] = str(cached.get("metric") or "train_tokens_per_sec_per_chip") + "_cached"
+            out["value"] = cached["value"]
+            out["unit"] = cached.get("unit") or "tokens/s/chip"
+            out["vs_baseline"] = cached.get("vs_baseline") or 0.0
+            if cached.get("mfu") is not None:
+                out["mfu"] = cached["mfu"]
+            out["extra"]["cached_tpu"] = cached
 
     # Artifact contract: exactly one JSON line, parseable, bounded size.
     line = json.dumps(_sanitize(out))
