@@ -413,9 +413,12 @@ def _cached_tpu_artifact() -> dict | None:
             continue
         if not isinstance(art, dict) or "value" not in art:
             continue
-        # never recycle a previous wedged-round output back as a measurement
-        # (provenance would degrade silently with each hop)
-        if str(art.get("metric", "")).endswith("_cached") or art.get("provenance") == "cached":
+        # never recycle a previous wedged-round output (provenance would
+        # degrade silently with each hop) nor a CPU-fallback artifact (not an
+        # on-chip measurement) back as the cached TPU number
+        metric = str(art.get("metric", ""))
+        if (metric.endswith("_cached") or "cpu_fallback" in metric
+                or art.get("provenance") == "cached"):
             continue
         ts = art.get("measured_at_utc")
         if not ts:  # fall back to the commit date of the artifact file
@@ -546,7 +549,10 @@ def main() -> None:
         # the BASELINE.json metric, even though the smaller 580m config posts
         # higher raw tok/s); otherwise the best throughput measured.
         ns = results.get("north_star_1_3b", {})
-        best = ns if ns.get("ok") else max(tpu_good, key=lambda r: r["tok_s_chip"])
+        # platform check matters: a wedged tunnel can silently drop a child
+        # onto CPU mid-ladder, and a CPU 1.3B number must never headline
+        best = (ns if ns.get("ok") and ns.get("platform") == "tpu"
+                else max(tpu_good, key=lambda r: r["tok_s_chip"]))
         flash = _run_child("flash", {}, 600.0)
         if not flash.get("ok"):
             errors.append(_truncate(f"flash: {flash.get('error')}"))

@@ -122,3 +122,36 @@ def test_msgpack_export_import_roundtrip(setup, tmp_path):
     path = ckpt_lib.export_params_msgpack(state.params, tmp_path / "params.msgpack")
     loaded = ckpt_lib.import_params_msgpack(path)
     tree_allclose(state.params, loaded)
+
+
+def test_remote_gs_path_not_mangled():
+    """gs:// directories must survive construction untouched (the reference's
+    deployment mode, main_zero.py:58-93 writes checkpoints to GCS buckets).
+    Round-3 bug: Path(directory).absolute() turned "gs://b/run" into
+    "/cwd/gs:/b/run". Construction + step-path formatting are storage-free,
+    so this runs with zero egress."""
+    mgr = ckpt_lib.CheckpointManager("gs://bucket/run")
+    assert str(mgr.directory) == "gs://bucket/run"
+    assert str(mgr.step_path(100)) == "gs://bucket/run/100"
+    assert str(mgr.step_path(0)) == "gs://bucket/run/0"
+    assert mgr._mgr_inst is None  # no orbax manager (= no bucket I/O) yet
+    mgr.close()  # close before first use must not touch storage either
+
+
+def test_local_path_still_absolutized(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    mgr = ckpt_lib.CheckpointManager("rel/ckpts")
+    assert mgr.directory.is_absolute()
+    assert str(mgr.directory) == str(tmp_path / "rel" / "ckpts")
+    mgr.close()
+
+
+def test_metrics_logger_remote_directory_no_mkdir(capsys):
+    from zero_transformer_tpu.utils.monitoring import MetricsLogger
+
+    logger = MetricsLogger(directory="gs://bucket/run")
+    assert logger._file is None  # JSONL sink gated off, not a mangled mkdir
+    logger.log({"loss": 1.0}, step=1)  # console path still works
+    logger.close()
+    out = capsys.readouterr().out
+    assert "JSONL sink disabled" in out and "loss=1" in out

@@ -32,6 +32,20 @@ import orbax.checkpoint as ocp
 from zero_transformer_tpu.parallel.zero import TrainState
 
 
+from zero_transformer_tpu.utils.paths import is_remote_path  # noqa: F401 (re-export)
+
+
+def resolve_ckpt_path(directory: str | Path):
+    """Local paths become absolute ``pathlib.Path``; remote URLs become
+    ``etils.epath.Path`` UNTOUCHED (``Path("gs://b").absolute()`` would mangle
+    the URL into ``/current/dir/gs:/b`` — the round-3 bug)."""
+    if is_remote_path(directory):
+        from etils import epath
+
+        return epath.Path(str(directory))
+    return Path(directory).absolute()
+
+
 def abstract_state(model, tx, plan, sample_input_shape) -> TrainState:
     """TrainState of ShapeDtypeStructs carrying target shardings — the restore
     target (and the structure any restore is validated against)."""
@@ -68,18 +82,42 @@ class CheckpointManager:
         save_frequency: int = 1000,
         async_save: bool = True,
     ):
-        self.directory = Path(directory).absolute()
+        self.directory = resolve_ckpt_path(directory)
         self.save_frequency = save_frequency
-        # interval gating is done here with a modulo (reference cadence:
-        # save at step % frequency == 0) — orbax's save_interval_steps
-        # instead anchors the cadence at the first saved step.
-        self._mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep,
-                enable_async_checkpointing=async_save,
-            ),
-        )
+        self._keep = keep
+        self._async_save = async_save
+        # The orbax manager is built LAZILY: its constructor touches storage
+        # (creates the root directory), which for a gs:// path would need
+        # bucket access just to instantiate. Path resolution/formatting must
+        # work storage-free (and is unit-tested that way).
+        self._mgr_inst: Optional[ocp.CheckpointManager] = None
+
+    @property
+    def _mgr(self) -> ocp.CheckpointManager:
+        if self._mgr_inst is None:
+            # interval gating is done here with a modulo (reference cadence:
+            # save at step % frequency == 0) — orbax's save_interval_steps
+            # instead anchors the cadence at the first saved step.
+            self._mgr_inst = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self._keep,
+                    enable_async_checkpointing=self._async_save,
+                ),
+            )
+        return self._mgr_inst
+
+    def step_path(self, step: int):
+        """Formatted path of one step's checkpoint directory (storage-free)."""
+        return self.directory / ocp.step.standard_name_format().build_name(step)
+
+    def ensure_ready(self) -> None:
+        """Force the first storage touch NOW (creates the root directory).
+        Call at job startup so a misconfigured directory — bad bucket name,
+        missing credentials — fails before hours of training, not at the
+        first interval save (laziness exists for storage-free construction,
+        not to defer validation)."""
+        self._mgr
 
     def save(
         self,
@@ -164,6 +202,8 @@ class CheckpointManager:
         self._mgr.wait_until_finished()
 
     def close(self) -> None:
+        if self._mgr_inst is None:
+            return  # never touched storage; nothing to flush
         self._mgr.wait_until_finished()
         self._mgr.close()
 
@@ -188,6 +228,6 @@ def import_params_msgpack(path: str | Path) -> Any:
 
 
 def save_config_json(directory: str | Path, flat_config: dict) -> None:
-    path = Path(directory)
-    path.mkdir(parents=True, exist_ok=True)
+    path = resolve_ckpt_path(directory)
+    path.mkdir(parents=True, exist_ok=True)  # epath: no-op dir on GCS
     (path / "config.json").write_text(json.dumps(flat_config, indent=2, default=str))

@@ -100,6 +100,9 @@ class Trainer:
             save_frequency=cfg.checkpoint.save_frequency,
             async_save=cfg.checkpoint.async_save,
         )
+        # fail fast on a bad checkpoint destination (wrong bucket, perms)
+        # before any compute is spent — the manager is otherwise lazy
+        self.ckpt.ensure_ready()
         from zero_transformer_tpu.config import flatten_config
 
         self.metrics = monitoring.MetricsLogger(

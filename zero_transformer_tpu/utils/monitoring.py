@@ -93,9 +93,18 @@ class MetricsLogger:
         if not self.enabled:
             return
         if directory is not None:
-            path = Path(directory)
-            path.mkdir(parents=True, exist_ok=True)
-            self._file = open(path / "metrics.jsonl", "a", buffering=1)
+            from zero_transformer_tpu.utils.paths import is_remote_path
+
+            if is_remote_path(directory):
+                # remote run directory (gs:// etc.): object stores don't
+                # support the append-mode JSONL sink; wandb carries remote
+                # metrics, and the console line always prints.
+                print(f"metrics: remote directory {directory}; JSONL sink disabled "
+                      "(use wandb for remote metric history)", flush=True)
+            else:
+                path = Path(directory)
+                path.mkdir(parents=True, exist_ok=True)
+                self._file = open(path / "metrics.jsonl", "a", buffering=1)
         if use_wandb:
             try:
                 import wandb

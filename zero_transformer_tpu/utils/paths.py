@@ -1,0 +1,13 @@
+"""Path-scheme helpers shared by checkpointing and metrics (dependency-free:
+importable without orbax/jax so host-side tools can use it)."""
+from __future__ import annotations
+
+from pathlib import Path
+
+
+def is_remote_path(path: "str | Path") -> bool:
+    """True for scheme-ful storage URLs (``gs://``, ``s3://``, ...) — the
+    reference's deployment mode writes checkpoints straight to GCS
+    (reference ``main_zero.py:58-93``, ``gs://bucket/...`` paths). Local
+    filesystem paths (absolute, relative, ``~``) are False."""
+    return "://" in str(path)
