@@ -8,6 +8,10 @@ PY ?= python
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
 # every push; CI adds PYTEST_ARGS="-n auto" (pytest-xdist) for multi-core.
+# tests/conftest.py keeps a persistent XLA compilation cache (override dir
+# via JAX_TEST_COMPILATION_CACHE); warm-cache timing 2026-07-30: full suite
+# 273 passed in 9m20 at -n 4 on a heavily loaded box (cold cache ran >2x
+# that). CI persists the cache across runs via actions/cache.
 test:
 	$(PY) -m pytest tests/ -x -q -m "not slow" $(PYTEST_ARGS)
 

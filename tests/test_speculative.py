@@ -138,3 +138,25 @@ def test_speculative_with_int8_kv_cache():
     )
     spec = generate_speculative(model, params, prompt, 32, draft_len=4)
     np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
+
+
+@pytest.mark.parametrize("temperature", [0.7, 1.3])
+def test_speculative_equals_greedy_with_temperature(temperature):
+    """Greedy + temperature: FP division can collapse near-equal logits into
+    a tie and flip the argmax, so the acceptance walk mirrors the SAME
+    cast-then-divide transform the plain loop applies (ADVICE r3) — the
+    outputs must be identical, not just argue-identical."""
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(4).integers(1, 64, (1, 12)), jnp.int32
+    )
+    plain = generate(
+        model, params, prompt, 40, jax.random.PRNGKey(0),
+        SamplingConfig(greedy=True, temperature=temperature,
+                       repetition_penalty=1.2),
+    )
+    spec = generate_speculative(
+        model, params, prompt, 40, draft_len=4,
+        repetition_penalty=1.2, temperature=temperature,
+    )
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))

@@ -251,3 +251,25 @@ def _npy_bytes(row):
     buf = io.BytesIO()
     np.save(buf, np.asarray(row))
     return buf.getvalue()
+
+
+def test_index_cwd_relative_fallback(tmp_path, monkeypatch):
+    """Legacy index whose relative entries were written against the training
+    job's cwd (pre-round-3 semantics): when the index-relative candidate
+    does not exist but the cwd-relative one does, the cwd one is used."""
+    from zero_transformer_tpu.data.tarshards import read_index
+
+    idx_dir = tmp_path / "indexes"
+    idx_dir.mkdir()
+    idx = idx_dir / "legacy.index"
+    idx.write_text("shards/part-0.tar\n")
+    cwd_shard = tmp_path / "shards" / "part-0.tar"
+    cwd_shard.parent.mkdir()
+    cwd_shard.write_bytes(b"")
+    monkeypatch.chdir(tmp_path)
+    assert read_index(idx) == ["shards/part-0.tar"]
+    # index-relative wins once it exists (the modern layout)
+    new_shard = idx_dir / "shards" / "part-0.tar"
+    new_shard.parent.mkdir()
+    new_shard.write_bytes(b"")
+    assert read_index(idx) == [str(idx_dir / "shards" / "part-0.tar")]

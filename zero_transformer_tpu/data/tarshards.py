@@ -67,6 +67,12 @@ def read_index(path: str | Path) -> List[str]:
     an index written next to its shards keeps working after the dataset
     directory is moved/copied, and is independent of the training job's
     cwd. Absolute paths and remote URLs (``gs://…``) pass through verbatim.
+
+    Compat: before round 3 relative entries resolved against the process
+    cwd. An index whose entries only exist relative to the cwd still loads
+    — the cwd-relative candidate is used as FALLBACK when the
+    index-relative path does not exist — but new indexes should be written
+    next to their shards.
     """
     base = Path(path).parent
     shards: List[str] = []
@@ -76,7 +82,20 @@ def read_index(path: str | Path) -> List[str]:
             continue
         for s in expand_braces(line):
             if "://" not in s and not Path(s).is_absolute():
-                s = str(base / s)
+                resolved = base / s
+                if not resolved.exists() and Path(s).exists():
+                    import logging
+
+                    # loud: a partially-copied dataset with a same-layout
+                    # dataset in the cwd would otherwise silently train on
+                    # the wrong shards
+                    logging.getLogger(__name__).warning(
+                        "index entry %r missing at %s; falling back to the "
+                        "legacy cwd-relative path %s",
+                        s, resolved, Path(s).resolve(),
+                    )
+                    resolved = Path(s)  # legacy cwd-relative index entry
+                s = str(resolved)
             shards.append(s)
     if not shards:
         raise ValueError(f"index {path} lists no shards")

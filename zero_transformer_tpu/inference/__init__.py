@@ -8,6 +8,8 @@ from zero_transformer_tpu.inference.generate import (
     generate_tokens,
     init_cache,
     prefill,
+    serve_mesh,
+    shard_for_inference,
     stream_tokens,
 )
 from zero_transformer_tpu.inference.sampling import (
@@ -30,6 +32,8 @@ __all__ = [
     "prefill",
     "process_logits",
     "sample_token",
+    "serve_mesh",
+    "shard_for_inference",
     "stream_tokens",
     "top_k_filter",
     "top_p_filter",

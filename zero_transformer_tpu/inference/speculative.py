@@ -54,7 +54,7 @@ def _set_cache_index(cache: Any, value: jax.Array) -> Any:
     return jax.tree_util.tree_map_with_path(one, cache)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
 def _spec_loop(
     model: Transformer,
     max_new: int,
@@ -62,6 +62,11 @@ def _spec_loop(
     eos_token_id: int,
     pad_token_id: int,
     penalty: float,  # repetition penalty (1.0 = off; emulated in acceptance)
+    temperature: float,  # mirrored bit-exactly from the plain path: FP
+    # division can collapse two near-equal logits into a tie and flip the
+    # argmax, so "temperature never changes the argmax" holds in real
+    # arithmetic but not in float32 — we apply the SAME transform instead
+    # of relying on the claim (x / 1.0 == x exactly, so the default is free)
     params: Any,
     hist0: jax.Array,  # [hist_len] int32: prompt then zeros
     t0: jax.Array,  # scalar: prompt length
@@ -98,7 +103,8 @@ def _spec_loop(
             {"params": params, "cache": cache}, x_in, mutable=["cache"]
         )
         cache = vars_out["cache"]
-        logits32 = logits[0].astype(jnp.float32)  # [K+1, V]
+        # same cast-then-divide order as sampling.process_logits
+        logits32 = logits[0].astype(jnp.float32) / temperature  # [K+1, V]
 
         # ---- accepted prefix + correction token
         if penalty == 1.0:
@@ -113,7 +119,8 @@ def _spec_loop(
             # tokens accepted before it, so acceptance walks the block
             # sequentially with the evolving generated-token mask — exactly
             # the trajectory the plain loop's sample_token takes (temperature
-            # and top-k/top-p never change the argmax; the penalty does)
+            # is already divided into logits32 above; top-k/top-p only mask
+            # non-argmax entries, so they are exactly argmax-neutral)
             draft_ext = jnp.concatenate([draft, jnp.full((1,), -1, jnp.int32)])
             is_last = jnp.arange(K + 1) == K
 
@@ -190,16 +197,20 @@ def generate_speculative(
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
     repetition_penalty: float = 1.0,
+    temperature: float = 1.0,
     return_stats: bool = False,
 ) -> jax.Array | Tuple[jax.Array, dict]:
     """Greedy prompt-lookup speculative decode. prompt [1, T] int32.
 
     Returns [1, max_new_tokens] int32 — identical to
-    ``generate(..., SamplingConfig(greedy=True, repetition_penalty=p))`` by
-    construction, in fewer model forwards on self-similar text (temperature
-    and top-k/top-p never change the argmax, so greedy with any of those
-    set is also reproduced). ``return_stats`` adds
-    ``{"forwards": n, "tokens_per_forward": ...}``.
+    ``generate(..., SamplingConfig(greedy=True, repetition_penalty=p,
+    temperature=t))`` by construction, in fewer model forwards on
+    self-similar text. The penalty AND the temperature division are applied
+    inside the acceptance walk with the same transforms the plain loop's
+    ``sample_token`` uses (FP division can flip an argmax on a collapsed
+    tie, so bit-exactness requires mirroring it rather than arguing it
+    away); top-k/top-p only mask non-argmax entries and need no emulation.
+    ``return_stats`` adds ``{"forwards": n, "tokens_per_forward": ...}``.
     """
     B, T0 = prompt.shape
     if B != 1:
@@ -225,8 +236,10 @@ def generate_speculative(
     cache = init_cache(model, 1)
     last_logits, cache = prefill(model, params, prompt, cache)
     # first token: nothing generated yet, so the penalty mask is empty and
-    # plain argmax matches the plain loop's first sample exactly
-    c0 = jnp.argmax(last_logits[0].astype(jnp.float32)).astype(jnp.int32)
+    # the temperature-scaled argmax matches the plain loop's first sample
+    c0 = jnp.argmax(
+        last_logits[0].astype(jnp.float32) / float(temperature)
+    ).astype(jnp.int32)
     V = last_logits.shape[-1]
     gen_mask0 = jnp.arange(V) == c0
 
@@ -236,7 +249,7 @@ def generate_speculative(
     out, n_fwd, n_emitted = _spec_loop(
         model, int(max_new_tokens), K,
         -1 if eos_token_id is None else int(eos_token_id), int(pad_token_id),
-        float(repetition_penalty),
+        float(repetition_penalty), float(temperature),
         params, hist, jnp.asarray(T0, jnp.int32), c0, gen_mask0, cache,
     )
     if return_stats:

@@ -28,6 +28,7 @@ from jax.nn import initializers
 
 from zero_transformer_tpu.config import ModelConfig, resolve_dtype
 from zero_transformer_tpu.models.moe import MoEMLP
+from zero_transformer_tpu.parallel.sharding import constrain_activation
 from zero_transformer_tpu.ops.attention import dot_product_attention, xla_attention
 from zero_transformer_tpu.ops.losses import next_token_loss
 from zero_transformer_tpu.ops.positions import apply_rope
@@ -112,9 +113,9 @@ class Attention(nn.Module):
         q = _dense(H * D, ("embed", "qheads"), 0.02, dtype, param_dtype, "query")(x)
         k = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "key")(x)
         v = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "value")(x)
-        q = q.reshape(B, T, H, D)
-        k = k.reshape(B, T, KVH, D)
-        v = v.reshape(B, T, KVH, D)
+        q = constrain_activation(q.reshape(B, T, H, D), "batch", "seq", "heads", "head_dim")
+        k = constrain_activation(k.reshape(B, T, KVH, D), "batch", "seq", "kvheads", "head_dim")
+        v = constrain_activation(v.reshape(B, T, KVH, D), "batch", "seq", "kvheads", "head_dim")
 
         use_cache = False
         offset = 0
@@ -209,7 +210,10 @@ class MLP(nn.Module):
         param_dtype = resolve_dtype(cfg.param_dtype)
         resid_std = 0.02 / (2 * cfg.n_layers) ** 0.5
         f = cfg.ff_dim
-        h = _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "wi")(x)
+        h = constrain_activation(
+            _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "wi")(x),
+            "batch", "seq", "mlp",
+        )
         if cfg.activation == "swiglu":
             g = _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "gate")(x)
             h = nn.silu(g) * h
@@ -249,6 +253,9 @@ class Block(nn.Module):
         )(
             _norm(cfg, x.dtype, "ln_attn")(x), doc_ids
         )
+        # pin the residual stream: batch/seq sharded, replicated over tensor
+        # (Megatron layout) — GSPMD must not invent another layout for it
+        x = constrain_activation(x, "batch", "seq", "embed")
         if cfg.n_experts > 0:
             mo, layer_aux = MoEMLP(cfg, self.deterministic, name="moe")(
                 _norm(cfg, x.dtype, "ln_mlp")(x)
@@ -259,6 +266,7 @@ class Block(nn.Module):
             x = x + MLP(cfg, self.deterministic, name="mlp")(
                 _norm(cfg, x.dtype, "ln_mlp")(x)
             )
+        x = constrain_activation(x, "batch", "seq", "embed")
         return ((x, aux, doc_ids) if packed else (x, aux)), None
 
 
@@ -294,7 +302,7 @@ class Transformer(nn.Module):
             param_dtype=param_dtype,
             name="wte",
         )
-        h = embed(x)
+        h = constrain_activation(embed(x), "batch", "seq", "embed")
 
         if cfg.position == "learned":
             if T > cfg.max_seq_len:

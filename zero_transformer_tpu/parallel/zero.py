@@ -108,6 +108,28 @@ def init_train_state(
     return jax.jit(_init, out_shardings=plan.state)(rng)
 
 
+def _with_ambient_mesh(jitted, mesh: Mesh):
+    """Run calls AND lowering of a jitted step under ``jax.set_mesh(mesh)``.
+
+    The model's ``constrain_activation`` calls resolve logical PartitionSpecs
+    against the ambient abstract mesh at TRACE time — which happens inside
+    the first call (or an explicit ``.lower``), not at ``jax.jit`` wrap time.
+    ``.lower`` is preserved because the HLO regression tests use it."""
+    import functools
+
+    @functools.wraps(jitted)
+    def call(*args, **kwargs):
+        with jax.set_mesh(mesh):
+            return jitted(*args, **kwargs)
+
+    def lower(*args, **kwargs):
+        with jax.set_mesh(mesh):
+            return jitted.lower(*args, **kwargs)
+
+    call.lower = lower
+    return call
+
+
 def make_train_step(
     model: nn.Module,
     tx: optax.GradientTransformation,
@@ -147,7 +169,9 @@ def make_train_step(
     if mesh.shape[PIPE_AXIS] > 1:
         from zero_transformer_tpu.parallel.pipeline import make_pp_train_step
 
-        return make_pp_train_step(model, tx, mesh, plan, zero_stage, schedule)
+        return make_pp_train_step(
+            model, tx, mesh, plan, zero_stage, schedule, tx_factory
+        )
     if zero_stage >= 2 and mesh.shape[SEQUENCE_AXIS] == 1:
         return _make_explicit_zero_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory
@@ -216,11 +240,14 @@ def make_train_step(
         return new_state, metrics
 
     batch_shard = NamedSharding(mesh, P(None, *plan.batch.spec))
-    return jax.jit(
-        train_step,
-        in_shardings=(plan.state, batch_shard, NamedSharding(mesh, P())),
-        out_shardings=(plan.state, NamedSharding(mesh, P())),
-        donate_argnums=(0,),
+    return _with_ambient_mesh(
+        jax.jit(
+            train_step,
+            in_shardings=(plan.state, batch_shard, NamedSharding(mesh, P())),
+            out_shardings=(plan.state, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        ),
+        mesh,
     )
 
 
@@ -233,6 +260,76 @@ def _zero_scatter_dim(spec: P, zaxes: tuple) -> int:
         if e == entry:
             return i
     return -1
+
+
+class ZeroCollectives:
+    """The hand-placed ZeRO collective schedule, reusable by any partial-
+    manual core whose manual axes include the ZeRO (data/fsdp) axes — the
+    explicit stage-2/3 step below AND the pipeline engine's stage-2 path
+    (``parallel.pipeline``). All methods are trace-time helpers meant to be
+    called INSIDE a shard_map body."""
+
+    def __init__(self, mesh: Mesh, plan: ShardingPlan):
+        self.zaxes = zero_axes(mesh)
+        self.axis = self.zaxes if len(self.zaxes) > 1 else self.zaxes[0]
+        self.zsize = math.prod(mesh.shape[a] for a in self.zaxes)
+        self.mesh = mesh
+        # -1 sentinel (None would vanish as an empty pytree)
+        self.sdims = jax.tree.map(
+            lambda ns: _zero_scatter_dim(ns.spec, self.zaxes), plan.zero
+        )
+
+    def dev_index(self):
+        idx = jax.lax.axis_index(self.zaxes[0])
+        for a in self.zaxes[1:]:
+            idx = idx * self.mesh.shape[a] + jax.lax.axis_index(a)
+        return idx
+
+    def shard_norm(self, tree):
+        """True global grad norm from shard-local pieces."""
+        sq_scattered = jnp.zeros((), jnp.float32)
+        sq_replicated = jnp.zeros((), jnp.float32)
+        for g, d in zip(jax.tree.leaves(tree), jax.tree.leaves(self.sdims)):
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if d < 0:
+                sq_replicated = sq_replicated + s
+            else:
+                sq_scattered = sq_scattered + s
+        return jnp.sqrt(jax.lax.psum(sq_scattered, self.axis) + sq_replicated)
+
+    def reduce_grads(self, grads):
+        """Full local grads → ZeRO-sharded mean grads (literal
+        reduce-scatter on the ICI ring; psum for indivisible leaves)."""
+
+        def one(g, d):
+            if d < 0:
+                return jax.lax.psum(g, self.axis)
+            return jax.lax.psum_scatter(
+                g, self.axis, scatter_dimension=d, tiled=True
+            )
+
+        return jax.tree.map(
+            lambda g: g / self.zsize, jax.tree.map(one, grads, self.sdims)
+        )
+
+    def gather_full(self, shards):
+        def one(p, d):
+            if d < 0:
+                return p
+            return jax.lax.all_gather(p, self.axis, axis=d, tiled=True)
+
+        return jax.tree.map(one, shards, self.sdims)
+
+    def slice_local(self, full):
+        def one(p, d):
+            if d < 0:
+                return p
+            size = p.shape[d] // self.zsize
+            return jax.lax.dynamic_slice_in_dim(
+                p, self.dev_index() * size, size, axis=d
+            )
+
+        return jax.tree.map(one, full, self.sdims)
 
 
 def _make_explicit_zero_step(
@@ -262,32 +359,10 @@ def _make_explicit_zero_step(
     and its clip under-measures large-grad steps (documented fallback for
     direct ``make_train_step`` callers that don't clip or don't care).
     """
-    zaxes = zero_axes(mesh)
-    axis = zaxes if len(zaxes) > 1 else zaxes[0]
-    zsize = math.prod(mesh.shape[a] for a in zaxes)
+    zc = ZeroCollectives(mesh, plan)
+    zaxes, axis = zc.zaxes, zc.axis
 
-    # -1 sentinel (None would vanish as an empty pytree)
-    sdims = jax.tree.map(lambda ns: _zero_scatter_dim(ns.spec, zaxes), plan.zero)
-
-    def dev_index():
-        idx = jax.lax.axis_index(zaxes[0])
-        for a in zaxes[1:]:
-            idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
-        return idx
-
-    def shard_norm(tree):
-        """True global grad norm from shard-local pieces."""
-        sq_scattered = jnp.zeros((), jnp.float32)
-        sq_replicated = jnp.zeros((), jnp.float32)
-        for g, d in zip(jax.tree.leaves(tree), jax.tree.leaves(sdims)):
-            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-            if d < 0:
-                sq_replicated = sq_replicated + s
-            else:
-                sq_scattered = sq_scattered + s
-        return jnp.sqrt(jax.lax.psum(sq_scattered, axis) + sq_replicated)
-
-    tx_inner = tx_factory(shard_norm) if tx_factory is not None else tx
+    tx_inner = tx_factory(zc.shard_norm) if tx_factory is not None else tx
 
     def loss_fn(params, micro, rng):
         _, loss = model.apply(
@@ -297,49 +372,23 @@ def _make_explicit_zero_step(
 
     grad_fn = jax.value_and_grad(loss_fn)
 
-    def reduce_grads(grads):
-        def one(g, d):
-            if d < 0:
-                return jax.lax.psum(g, axis)
-            return jax.lax.psum_scatter(g, axis, scatter_dimension=d, tiled=True)
-
-        # psum/psum_scatter SUM over devices; the DP mean needs /zsize
-        return jax.tree.map(lambda g: g / zsize, jax.tree.map(one, grads, sdims))
-
-    def gather_full(shards):
-        def one(p, d):
-            if d < 0:
-                return p
-            return jax.lax.all_gather(p, axis, axis=d, tiled=True)
-
-        return jax.tree.map(one, shards, sdims)
-
-    def slice_local(full):
-        def one(p, d):
-            if d < 0:
-                return p
-            size = p.shape[d] // zsize
-            return jax.lax.dynamic_slice_in_dim(p, dev_index() * size, size, axis=d)
-
-        return jax.tree.map(one, full, sdims)
-
     def core(state: TrainState, batch: jax.Array, rng: jax.Array):
         accum = batch.shape[0]
         step_rng = jax.random.fold_in(rng, state.step)
         # distinct dropout masks per DP shard (pmap-era fold-in semantics)
-        step_rng = jax.random.fold_in(step_rng, dev_index())
+        step_rng = jax.random.fold_in(step_rng, zc.dev_index())
 
         if zero_stage >= 3:
             param_shards = state.params
-            full_params = gather_full(param_shards)  # FSDP per-step all-gather
+            full_params = zc.gather_full(param_shards)  # FSDP per-step all-gather
         else:
             full_params = state.params
-            param_shards = slice_local(full_params)
+            param_shards = zc.slice_local(full_params)
 
         def micro(i):
             mrng = jax.random.fold_in(step_rng, i)
             loss, grads = grad_fn(full_params, batch[i], mrng)
-            return jax.lax.pmean(loss, axis), reduce_grads(grads)
+            return jax.lax.pmean(loss, axis), zc.reduce_grads(grads)
 
         if accum == 1:
             loss, grads = micro(0)
@@ -359,14 +408,14 @@ def _make_explicit_zero_step(
             loss = loss / accum
             grads = jax.tree.map(lambda g: g / accum, grads)
 
-        grad_norm = shard_norm(grads)
+        grad_norm = zc.shard_norm(grads)
         updates, new_opt = tx_inner.update(grads, state.opt_state, param_shards)
         new_shards = optax.apply_updates(param_shards, updates)
-        new_params = new_shards if zero_stage >= 3 else gather_full(new_shards)
+        new_params = new_shards if zero_stage >= 3 else zc.gather_full(new_shards)
         metrics = {
             "loss": loss,
             "grad_norm": grad_norm,
-            "tokens": jnp.asarray(batch.size * zsize, jnp.float32),
+            "tokens": jnp.asarray(batch.size * zc.zsize, jnp.float32),
         }
         if schedule is not None:
             metrics["learning_rate"] = schedule(state.step)
@@ -402,11 +451,14 @@ def _make_explicit_zero_step(
         axis_names=frozenset(zaxes),
         check_vma=False,
     )
-    return jax.jit(
-        mapped,
-        in_shardings=(plan.state, NamedSharding(mesh, batch_spec), NamedSharding(mesh, P())),
-        out_shardings=(plan.state, NamedSharding(mesh, P())),
-        donate_argnums=(0,),
+    return _with_ambient_mesh(
+        jax.jit(
+            mapped,
+            in_shardings=(plan.state, NamedSharding(mesh, batch_spec), NamedSharding(mesh, P())),
+            out_shardings=(plan.state, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        ),
+        mesh,
     )
 
 
@@ -418,8 +470,11 @@ def make_eval_step(model: nn.Module, mesh: Mesh, plan: ShardingPlan) -> Callable
         _, loss = model.apply({"params": params}, batch, labels=batch)
         return loss
 
-    return jax.jit(
-        eval_step,
-        in_shardings=(plan.state.params, plan.batch),
-        out_shardings=NamedSharding(mesh, P()),
+    return _with_ambient_mesh(
+        jax.jit(
+            eval_step,
+            in_shardings=(plan.state.params, plan.batch),
+            out_shardings=NamedSharding(mesh, P()),
+        ),
+        mesh,
     )

@@ -58,13 +58,30 @@ class TextGenerator:
     """Tokenizer + params + compiled decode loop behind one ``__call__``."""
 
     def __init__(self, cfg, params: Any, tokenizer, cache_len: Optional[int] = None,
-                 speculative: int = 0):
+                 speculative: int = 0, tensor: int = 1):
         from zero_transformer_tpu.inference import decode_model
 
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.cache_len = cache_len or cfg.max_seq_len
         self.model = decode_model(cfg, self.cache_len)
+        # tensor>1: shard params/cache over a pure-TP mesh so models larger
+        # than one chip's HBM serve (llama3_8b on 4-8 chips); outputs match
+        # single-chip decode (tested argmax-identical)
+        self.mesh = None
+        if tensor > 1:
+            from zero_transformer_tpu.inference import serve_mesh, shard_for_inference
+
+            self.mesh = serve_mesh(tensor)
+            params = shard_for_inference(self.model, params, self.mesh)
+            if speculative:
+                print(
+                    "serve: --speculative is single-chip only and is "
+                    "DISABLED under --tensor>1 (requests take the plain "
+                    "decode path)",
+                    flush=True,
+                )
+                speculative = 0
         self.params = params
         # draft length for prompt-lookup speculative decoding (greedy one-shot
         # generation only; 0 = off)
@@ -98,12 +115,14 @@ class TextGenerator:
             repetition_penalty, greedy,
         )
         # draft scratch must fit the cache (prompt + new + K); shrink K to
-        # whatever fits rather than erroring at the budget edge. temperature
-        # and top-k/top-p never change the argmax, and the repetition
-        # penalty is emulated inside the acceptance walk, so every greedy
-        # configuration routes through speculation.
+        # whatever fits rather than erroring at the budget edge. every greedy
+        # configuration routes through speculation: top-k/top-p are exactly
+        # argmax-neutral, and the temperature division + repetition penalty
+        # are mirrored bit-exactly inside the acceptance walk.
         spec_k = min(self.speculative, self.cache_len - len(ids) - max_new_tokens)
-        if spec_k > 0 and greedy:
+        # speculation is single-chip only for now: its draft/verify loop does
+        # not take a mesh (TP serving goes through the plain path)
+        if spec_k > 0 and greedy and self.mesh is None:
             from zero_transformer_tpu.inference import generate_speculative
 
             out = generate_speculative(
@@ -111,6 +130,7 @@ class TextGenerator:
                 max_new_tokens, draft_len=spec_k,
                 eos_token_id=eos, pad_token_id=eos if eos is not None else 0,
                 repetition_penalty=repetition_penalty,
+                temperature=temperature,
             )
             toks = [t for t in out[0].tolist() if t != eos]
             return self._decode(toks)
@@ -125,6 +145,7 @@ class TextGenerator:
             # pad finished rows with EOS so stripping EOS below also strips
             # padding, whatever the tokenizer's ids are
             pad_token_id=eos if eos is not None else 0,
+            mesh=self.mesh,
         )
         toks = [t for t in out[0].tolist() if t != eos]
         return self._decode(toks)
@@ -175,7 +196,7 @@ class TextGenerator:
         for token in stream_tokens(
             self.model, self.params, jnp.asarray([ids], jnp.int32),
             max_new_tokens, jax.random.PRNGKey(seed), sampling,
-            eos_token_id=eos,
+            eos_token_id=eos, mesh=self.mesh,
         ):
             t = int(token[0])
             if eos is not None and t == eos:
@@ -203,7 +224,7 @@ def _build_generator(args) -> TextGenerator:
     tokenizer = _load_tokenizer(args.tokenizer)
     return TextGenerator(
         cfg, params, tokenizer, cache_len=args.cache_len,
-        speculative=args.speculative,
+        speculative=args.speculative, tensor=args.tensor,
     )
 
 
@@ -274,6 +295,10 @@ def main(argv=None) -> None:
                    help="int8 halves KV-cache HBM traffic (doubles servable "
                         "context) at slight quantization cost")
     p.add_argument("--cache-len", type=int, default=None)
+    p.add_argument("--tensor", type=int, default=1, metavar="N",
+                   help="tensor-parallel serving over the first N chips "
+                        "(params + KV cache shard over heads/features; "
+                        "serves models larger than one chip's HBM)")
     p.add_argument("--speculative", type=int, default=0, metavar="K",
                    help="prompt-lookup speculative decoding with K-token "
                         "drafts (greedy one-shot generation; exact same "
