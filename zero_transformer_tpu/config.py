@@ -187,6 +187,17 @@ class MeshConfig:
     # ZeRO stage: 0 = plain DP, 1 = opt-state sharded, 2 = +grad reduce-scatter,
     # 3 = +param sharded (FSDP). Reference implements stage 1 only (SURVEY §2).
     zero_stage: int = 1
+    # pipeline schedule (pipe > 1): "gpipe" = fill-drain wavefront, activation
+    # stash O(M) microbatches; "1f1b" = one-forward-one-backward ticks with
+    # stash-and-recompute, activation stash O(P) — use when M (accumulation
+    # depth) at the target context no longer fits HBM. See docs/DESIGN.md.
+    pp_schedule: str = "gpipe"
+
+    def __post_init__(self):
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pp_schedule must be 'gpipe' or '1f1b', got {self.pp_schedule!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

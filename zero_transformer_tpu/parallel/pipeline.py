@@ -45,6 +45,17 @@ def _pipe_part(spec: P) -> P:
     return restrict_spec(spec, {PIPE_AXIS})
 
 
+def _has_pipe(spec: P) -> bool:
+    """True when a param spec shards over the pipe axis (the stacked layer
+    blocks); False for pipe-REPLICATED params (wte, final norm, head) whose
+    gradients arrive as per-rank partials and need a pipe-psum."""
+    return any(
+        PIPE_AXIS in (e if isinstance(e, tuple) else (e,))
+        for e in spec
+        if e is not None
+    )
+
+
 def make_pp_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -53,6 +64,7 @@ def make_pp_train_step(
     zero_stage: int = 1,
     schedule: Optional[Callable] = None,
     tx_factory: Optional[Callable] = None,
+    pp_schedule: str = "gpipe",
 ) -> Callable:
     """Fused train step for meshes with an active ``pipe`` axis.
 
@@ -92,6 +104,13 @@ def make_pp_train_step(
 
     cfg = model.cfg
     n_stages = mesh.shape[PIPE_AXIS]
+    if pp_schedule not in ("gpipe", "1f1b"):
+        # validate at the API boundary too (MeshConfig validates its own
+        # field, but direct callers bypass it) — a typo must not silently
+        # build the gpipe schedule while the user expects 1F1B's O(P) memory
+        raise ValueError(
+            f"pp_schedule must be 'gpipe' or '1f1b', got {pp_schedule!r}"
+        )
     if zero_stage >= 3:
         raise NotImplementedError(
             "pipeline parallelism supports ZeRO stage 0-2; stage 3 (params "
@@ -158,36 +177,57 @@ def make_pp_train_step(
         metadata_params={nn.PARTITION_NAME: "layers"},
     )(cfg, False, False, None, None)  # deterministic=False: train step
 
-    def core(params, batch, rng):
+    def stage_slot(p, x, mb, batch, rng, rank):
+        """THE per-rank stage forward — single source for every schedule
+        (GPipe ticks, both 1F1B slots, and through them the ZeRO-2 core).
+        Returns ``(h_out, (loss, aux))`` for microbatch ``mb`` given inbox
+        activation ``x``. Rank-dependent work is where-masked (embed feeds
+        h_in only on rank 0; the head+loss value is only meaningful where
+        the caller masks it for the last rank) — SPMD, one compiled body.
+        Every rank holds the full pipe-replicated batch, so packed-document
+        ids are re-derived locally with the ONE shared rule
+        (models/gpt.py doc_ids_from_tokens) instead of riding the hops."""
+        M = batch.shape[0]
+        tokens = batch[jnp.clip(mb, 0, M - 1)]
+        emb = embed_mod.apply({"params": p["wte"]}, tokens)
+        h_in = jnp.where(rank == 0, emb, x)
+        mrng = jax.random.fold_in(jax.random.fold_in(rng, mb), rank)
+        carry_in = (h_in.astype(dtype), jnp.zeros((), jnp.float32))
+        if packed:
+            carry_in = carry_in + (doc_ids_from_tokens(tokens, cfg.doc_sep_token),)
+        (h_out, aux, *_), _ = stage_mod.apply(
+            {"params": p["blocks"]}, carry_in, rngs={"dropout": mrng}
+        )
+        h_norm = norm_mod.apply({"params": p["ln_f"]}, h_out)
+        if cfg.tie_embeddings:
+            logits = embed_mod.apply({"params": p["wte"]}, h_norm, method="attend")
+        else:
+            logits = head_mod.apply({"params": p["lm_head"]}, h_norm)
+        if packed:
+            labels = mask_boundary_labels(
+                tokens, doc_ids_from_tokens(tokens, cfg.doc_sep_token)
+            )
+            loss = next_token_loss(logits, labels, ignore_index=-1)
+        else:
+            loss = next_token_loss(logits, tokens)
+        return h_out, (loss, aux)
+
+    def core(params, batch, rng, reduce=True):
+        """GPipe wavefront loss. ``reduce=True`` returns the pipe-psum'd
+        total (the stage-0/1 shard_map, whose ``out_specs=P()`` transpose
+        handles replication correctly). ``reduce=False`` returns the
+        rank-LOCAL (loss_sum + aux_sum)/M — REQUIRED when differentiating
+        inside a pipe-manual region (the ZeRO-2 core): seeding cotangent 1
+        on every rank of a psum-produced replicated loss makes the psum
+        transpose sum P cotangents and scales every gradient by P. Adam +
+        norm-clipping are scale-invariant, so trajectories still matched —
+        the observable damage was grad_norm (and the clip threshold)
+        off by exactly P. Cross-rank gradient flow still works without the
+        psum: cotangents ride the ppermute transposes back through the
+        scan."""
         rank = jax.lax.axis_index(PIPE_AXIS)
         M = batch.shape[0]
         n_ticks = M + n_stages - 1
-
-        def embed_mb(i):
-            x = batch[jnp.clip(i, 0, M - 1)]
-            return embed_mod.apply({"params": params["wte"]}, x)
-
-        def ids_mb(i):
-            # every rank holds the full (pipe-replicated) batch, so the
-            # packed-document ids need not ride the stage carry hops — each
-            # rank derives them for whatever microbatch it is working on,
-            # with the ONE shared rule (models/gpt.py doc_ids_from_tokens)
-            x = batch[jnp.clip(i, 0, M - 1)]
-            return doc_ids_from_tokens(x, cfg.doc_sep_token)
-
-        def head_loss_mb(h, i):
-            x = batch[jnp.clip(i, 0, M - 1)]
-            h = norm_mod.apply({"params": params["ln_f"]}, h)
-            if cfg.tie_embeddings:
-                logits = embed_mod.apply(
-                    {"params": params["wte"]}, h, method="attend"
-                )
-            else:
-                logits = head_mod.apply({"params": params["lm_head"]}, h)
-            if packed:
-                labels = mask_boundary_labels(x, ids_mb(i))
-                return next_token_loss(logits, labels, ignore_index=-1)
-            return next_token_loss(logits, x)
 
         def tick(carry, t):
             outbox, loss_sum, aux_sum = carry
@@ -199,26 +239,16 @@ def make_pp_train_step(
                 [(i, (i + 1) % n_stages) for i in range(n_stages)],
             )
             mb = t - rank  # microbatch this rank works on at tick t
-            h_in = jnp.where(rank == 0, embed_mb(t), inbox)
-            mrng = jax.random.fold_in(jax.random.fold_in(rng, mb), rank)
-            carry_in = (h_in.astype(dtype), jnp.zeros((), jnp.float32))
-            if packed:
-                carry_in = carry_in + (ids_mb(mb),)
-            (h_out, aux, *_), _ = stage_mod.apply(
-                {"params": params["blocks"]},
-                carry_in,
-                rngs={"dropout": mrng},
-            )
-            mb_done = t - (n_stages - 1)  # microbatch finishing at the tail
-            loss_t = head_loss_mb(h_out, mb_done)
+            h_out, (loss_t, aux) = stage_slot(params, inbox, mb, batch, rng, rank)
+            # only the last rank's loss counts, and there mb IS the
+            # microbatch finishing at the tail (mb = t - (P-1) = mb_done)
             is_last = rank == n_stages - 1
-            loss_sum = loss_sum + jnp.where(
-                is_last & (mb_done >= 0), loss_t, 0.0
-            )
+            loss_sum = loss_sum + jnp.where(is_last & (mb >= 0), loss_t, 0.0)
             aux_sum = aux_sum + jnp.where((mb >= 0) & (mb < M), aux, 0.0)
             return (h_out, loss_sum, aux_sum), None
 
-        h0 = embed_mb(0) * 0.0  # bubble payload; shape [b, T, d]
+        # bubble payload; shape [b, T, d]
+        h0 = jnp.zeros((batch.shape[1], batch.shape[2], cfg.d_model), dtype)
         # scan, not fori_loop: the wavefront must be reverse-differentiable
         # (grad through it produces the GPipe drain schedule)
         (_, loss_sum, aux_sum), _ = jax.lax.scan(
@@ -226,11 +256,105 @@ def make_pp_train_step(
             (h0.astype(dtype), jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
             jnp.arange(n_ticks),
         )
+        local = loss_sum / M
+        if cfg.n_experts > 0:
+            local = local + aux_sum / M
+        if not reduce:
+            return local
+        return jax.lax.psum(local, PIPE_AXIS)
+
+    # ------------------------------------------------ 1F1B schedule (opt-in)
+    # Unified fwd+bwd ticks with a stash-and-recompute backward: each rank
+    # keeps only the INPUT activation of every in-flight microbatch (a ring
+    # of S = 2P slots, O(P) — GPipe's grad-through-scan stashes O(M) carry
+    # activations) and re-runs the stage forward inside jax.vjp on the
+    # backward slot. Schedule: at tick t rank r forwards microbatch t - r
+    # and backwards microbatch t - 2(P-1) + r, so the last rank's forward
+    # and backward of the same microbatch share a tick (fwd -> loss -> seed
+    # cotangent immediately — the 1F1B property). Total ticks M + 2P - 2.
+    # Trade: ~one extra stage-forward per microbatch vs GPipe-with-remat
+    # (the fwd slot's output cannot wait for the bwd slot's recompute), so
+    # use it when accumulation depth M at the target context has outgrown
+    # HBM, not as the default. See docs/DESIGN.md.
+    def core_1f1b(params, batch, rng):
+        rank = jax.lax.axis_index(PIPE_AXIS)
+        is_last = rank == n_stages - 1
+        M, b, T = batch.shape
+        n_ticks = M + 2 * (n_stages - 1)
+        S = 2 * n_stages  # ring slots; in-flight span is 2(P-1-r) < S
+
+        def fwd_fn(p, x, mb):
+            return stage_slot(p, x, mb, batch, rng, rank)
+
+        fwd_ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_ring = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            out_f, out_b, stash, grads, loss_sum, aux_sum = carry
+            inbox_f = jax.lax.ppermute(out_f, PIPE_AXIS, fwd_ring)
+            inbox_b = jax.lax.ppermute(out_b, PIPE_AXIS, bwd_ring)
+            mb_f = t - rank
+            mb_b = t - 2 * (n_stages - 1) + rank
+            b_valid = (mb_b >= 0) & (mb_b < M)
+
+            # forward slot: emit y now, stash the INPUT for the bwd slot.
+            # Out-of-range mb_f writes land in ring slots outside the
+            # in-flight span (span < S), so they can never clobber a live one.
+            y_f, _ = fwd_fn(params, inbox_f, mb_f)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, inbox_f.astype(dtype), jnp.mod(mb_f, S), 0
+            )
+
+            # backward slot: recompute the stage at the stashed input, seed
+            # cotangents — upstream dx for interior ranks, d(loss)=1 on the
+            # last rank (whose fwd of mb_b happened THIS tick, same slot)
+            x_b = jax.lax.dynamic_index_in_dim(stash, jnp.mod(mb_b, S), 0, keepdims=False)
+            (y_b, (loss_b, aux_b)), vjp = jax.vjp(
+                lambda p, x: fwd_fn(p, x, mb_b), params, x_b
+            )
+            gy = jnp.where(is_last, 0.0, inbox_b).astype(y_b.dtype)
+            gloss = jnp.where(is_last, 1.0, 0.0).astype(loss_b.dtype)
+            gaux = jnp.asarray(1.0 if cfg.n_experts > 0 else 0.0, aux_b.dtype)
+            dparams, dx = vjp((gy, (gloss, gaux)))
+            grads = jax.tree.map(
+                lambda a, g: a + jnp.where(b_valid, g, 0).astype(a.dtype),
+                grads, dparams,
+            )
+            loss_sum = loss_sum + jnp.where(b_valid & is_last, loss_b, 0.0)
+            aux_sum = aux_sum + jnp.where(b_valid, aux_b, 0.0)
+            return (y_f.astype(dtype), dx.astype(dtype), stash, grads,
+                    loss_sum, aux_sum), None
+
+        zero_x = jnp.zeros((b, T, cfg.d_model), dtype)
+        carry0 = (
+            zero_x, zero_x,
+            jnp.zeros((S, b, T, cfg.d_model), dtype),
+            jax.tree.map(jnp.zeros_like, params),
+            jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, grads, loss_sum, aux_sum), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(n_ticks)
+        )
         loss = jax.lax.psum(loss_sum, PIPE_AXIS) / M
         if cfg.n_experts > 0:
             loss = loss + jax.lax.psum(aux_sum, PIPE_AXIS) / M
-        return loss
+        grads = jax.tree.map(lambda g: g / M, grads)
+        # pipe-replicated params (wte, ln_f, head): each rank accumulated
+        # only its own where-masked contributions — sum them across ranks
+        # (the GPipe path gets this from the shard_map transpose)
+        pipe_sharded = jax.tree.map(lambda ns: _has_pipe(ns.spec), plan.state.params)
+        grads = jax.tree.map(
+            lambda g, hp: g if hp else jax.lax.psum(g, PIPE_AXIS),
+            grads, pipe_sharded,
+        )
+        return loss, grads
 
+    if pp_schedule == "1f1b" and zero_stage >= 2:
+        raise NotImplementedError(
+            "pp_schedule='1f1b' supports ZeRO stage 0/1 (the explicit "
+            "stage-2 core wraps the GPipe wavefront); use pp_schedule="
+            "'gpipe' with zero_stage=2"
+        )
     if zero_stage >= 2:
         return _pp_zero2_step(core, tx, mesh, plan, schedule, tx_factory)
 
@@ -243,15 +367,26 @@ def make_pp_train_step(
         axis_names=frozenset({PIPE_AXIS}),
         check_vma=False,
     )
+    pp_grads_1f1b = shard_map(
+        core_1f1b,
+        mesh=mesh,
+        in_specs=(param_specs, P(), P()),
+        out_specs=(P(), param_specs),
+        axis_names=frozenset({PIPE_AXIS}),
+        check_vma=False,
+    )
 
     def constrain_zero(tree):
         return jax.lax.with_sharding_constraint(tree, plan.zero)
 
     def train_step(state: TrainState, batch: jax.Array, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)
-        loss, grads = jax.value_and_grad(
-            lambda p: pp_loss(p, batch, step_rng)
-        )(state.params)
+        if pp_schedule == "1f1b":
+            loss, grads = pp_grads_1f1b(state.params, batch, step_rng)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: pp_loss(p, batch, step_rng)
+            )(state.params)
         grad_norm = optax.global_norm(grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         if zero_stage >= 1:
@@ -303,13 +438,6 @@ def _pp_zero2_step(
     zaxes = zero_axes(mesh)
     manual = frozenset({PIPE_AXIS, *zaxes})
 
-    def _has_pipe(spec: P) -> bool:
-        return any(
-            PIPE_AXIS in (e if isinstance(e, tuple) else (e,))
-            for e in spec
-            if e is not None
-        )
-
     # True for params SHARDED over pipe (the stacked blocks); False for
     # pipe-REPLICATED ones (wte, final norm, untied head) whose gradients
     # arrive as per-rank partials — rank 0 does the embedding work, the last
@@ -346,10 +474,12 @@ def _pp_zero2_step(
         full_params = state.params  # stage 2: stored full along ZeRO axes
         param_shards = zc.slice_local(full_params)
 
-        loss, grads = jax.value_and_grad(
-            lambda p: wavefront(p, batch, step_rng)
+        # differentiate the rank-LOCAL loss: see the wavefront docstring —
+        # differentiating the psum'd loss in here would scale grads by P
+        local_loss, grads = jax.value_and_grad(
+            lambda p: wavefront(p, batch, step_rng, reduce=False)
         )(full_params)
-        loss = jax.lax.pmean(loss, zc.axis)
+        loss = jax.lax.pmean(jax.lax.psum(local_loss, PIPE_AXIS), zc.axis)
         # pipe-replicated params: sum the per-rank partial grads (see
         # pipe_sharded above) BEFORE the ZeRO reduce-scatter over data
         grads = jax.tree.map(

@@ -138,6 +138,7 @@ def make_train_step(
     zero_stage: int = 1,
     schedule: Optional[Callable] = None,
     tx_factory: Optional[Callable] = None,
+    pp_schedule: str = "gpipe",
 ) -> Callable:
     """Build the fused jitted train step.
 
@@ -170,7 +171,8 @@ def make_train_step(
         from zero_transformer_tpu.parallel.pipeline import make_pp_train_step
 
         return make_pp_train_step(
-            model, tx, mesh, plan, zero_stage, schedule, tx_factory
+            model, tx, mesh, plan, zero_stage, schedule, tx_factory,
+            pp_schedule=pp_schedule,
         )
     if zero_stage >= 2 and mesh.shape[SEQUENCE_AXIS] == 1:
         return _make_explicit_zero_step(

@@ -84,6 +84,7 @@ class Trainer:
             # lets the explicit ZeRO-2/3 core rebuild the optimizer with a
             # shard-aware grad-clip norm (same opt-state structure)
             tx_factory=lambda norm_fn: make_optimizer(opt, self.schedule, norm_fn),
+            pp_schedule=cfg.mesh.pp_schedule,
         )
         self.eval_step = make_eval_step(self.model, self.mesh, self.plan)
         self.batch_sharding = NamedSharding(
