@@ -198,6 +198,13 @@ class MeshConfig:
             raise ValueError(
                 f"pp_schedule must be 'gpipe' or '1f1b', got {self.pp_schedule!r}"
             )
+        if self.pp_schedule != "gpipe" and self.pipe == 1:
+            # loud, not silent: without a pipe axis the schedule choice
+            # would be ignored while the user expects 1F1B's O(P) memory
+            raise ValueError(
+                f"pp_schedule={self.pp_schedule!r} requires pipe > 1 "
+                f"(got pipe={self.pipe})"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
