@@ -387,7 +387,7 @@ def child_flash() -> dict:
 # ------------------------------------------------------------------- parent
 
 
-def _cached_tpu_artifact() -> dict | None:
+def _cached_tpu_artifact(root: str | None = None) -> dict | None:
     """Most recent committed on-chip measurement, for the wedged-tunnel case.
 
     The axon TPU tunnel can hang at backend init for hours (observed rounds
@@ -399,7 +399,8 @@ def _cached_tpu_artifact() -> dict | None:
     """
     import glob
 
-    root = os.path.dirname(os.path.abspath(__file__))
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
     candidates = [os.path.join(root, "BENCH_measured.json")]
     candidates += sorted(glob.glob(os.path.join(root, "docs", "bench", "*.json")), reverse=True)
     candidates += sorted(glob.glob(os.path.join(root, "BENCH_r*_measured.json")), reverse=True)

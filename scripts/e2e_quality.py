@@ -100,7 +100,7 @@ def gather_corpus(out_dir: Path, cap_bytes: int, heldout_frac: float = 0.05):
 
 
 def build_eval_files(heldout: list[str], data_dir: Path, max_ppl_bytes: int,
-                     max_lambada: int):
+                     max_lambada: int, ctx: int = 512):
     """Pre-tokenized (byte) eval JSONLs for the in-tree evalharness."""
     # ppl / bpb: one big token stream from held-out docs
     stream = "\n\n".join(heldout)[:max_ppl_bytes]
@@ -133,8 +133,54 @@ def build_eval_files(heldout: list[str], data_dir: Path, max_ppl_bytes: int,
                     break
             if n >= max_lambada:
                 break
-    print(f"eval files: {len(tokens)} ppl bytes, {n} last-word examples",
-          flush=True)
+    # PIQA/Winogrande-style choice task (the reference's other published
+    # metric shape, reference README.md:53-57): pick the paragraph's TRUE
+    # second half among distractor continuations taken from other
+    # paragraphs. Gold position round-robins over the example index.
+    paras = [
+        p.strip() for doc in heldout for p in doc.split("\n\n")
+        if 200 <= len(p.strip()) <= 900
+    ]
+    if len(paras) < 4:
+        raise SystemExit(
+            f"only {len(paras)} usable paragraphs — too few for the choice task"
+        )
+    cap = max(32, ctx // 2 - 8)  # scoring.py needs continuation BYTES < seq_len
+
+    def second_half(s: str) -> tuple[str, str]:
+        """Split at a whitespace boundary near the middle: a mid-word cut
+        would let spelling alone identify the gold continuation."""
+        cut = s.find(" ", len(s) // 2)
+        cut = cut if cut != -1 else len(s) // 2
+        return s[:cut], s[cut:]
+
+    def cap_b(s: str) -> str:
+        # cap in BYTES, not characters — multi-byte UTF-8 would otherwise
+        # overflow the scoring window
+        return s.encode()[:cap].decode("utf-8", errors="ignore")
+
+    n_choice = 0
+    with open(data_dir / "heldout_choice.jsonl", "w") as f:
+        for i, para in enumerate(paras):
+            context, true_cont = second_half(para)
+            cands = [
+                cap_b(true_cont),
+                cap_b(second_half(paras[(i + 1) % len(paras)])[1]),
+                cap_b(second_half(paras[(i + 2) % len(paras)])[1]),
+            ]
+            gold = i % 3  # round-robin gold position by example index
+            cands[0], cands[gold] = cands[gold], cands[0]
+            f.write(json.dumps({
+                "context": list(context.encode()),
+                "choices": [list(c.encode()) for c in cands],
+                "gold": gold,
+                "choice_bytes": [len(c.encode()) for c in cands],
+            }) + "\n")
+            n_choice += 1
+            if n_choice >= (20 if len(paras) < 100 else 200):
+                break
+    print(f"eval files: {len(tokens)} ppl bytes, {n} last-word examples, "
+          f"{n_choice} choice examples", flush=True)
     if n == 0:
         raise SystemExit("no last-word examples extracted")
 
@@ -182,15 +228,16 @@ def main() -> None:
 
     shutil.rmtree(out / "ckpt", ignore_errors=True)
 
+    ctx = 128 if smoke else 512
     train, heldout = gather_corpus(data_dir, cap_bytes=cap)
     build_eval_files(
         heldout, data_dir,
         max_ppl_bytes=(50_000 if smoke else 400_000),
         max_lambada=(40 if smoke else 400),
+        ctx=ctx,
     )
 
     # --- prepare: tar shards + index for train AND a small val split
-    ctx = 128 if smoke else 512
     for split, inp in (("train", data_dir / "train.jsonl"),
                        ("val", data_dir / "heldout.jsonl")):
         run_cli("zero_transformer_tpu.data.prepare",
@@ -245,7 +292,8 @@ def main() -> None:
                    "--seq-len", ctx,
                    "--dtype", "float32" if smoke else "bfloat16"]
     for task, data in (("bpb", "heldout_ppl.jsonl"),
-                       ("lambada", "heldout_lastword.jsonl")):
+                       ("lambada", "heldout_lastword.jsonl"),
+                       ("choice", "heldout_choice.jsonl")):
         proc = run_cli("zero_transformer_tpu.evalharness.cli",
                        eval_common + ["--task", task, "--data", data_dir / data],
                        force_cpu=force_cpu,

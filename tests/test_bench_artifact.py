@@ -1,0 +1,91 @@
+"""bench.py parent-side logic: cached-artifact selection for wedged-tunnel
+rounds, and the string-sanitization contract that keeps the one-line JSON
+artifact parseable. No jax — these are host-side unit tests of the round
+evidence chain (round-3 VERDICT weak #1: a wedged tunnel zeroed the round's
+official record)."""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+def _write(path: Path, obj: dict):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(obj))
+
+
+def test_cached_artifact_prefers_canonical(tmp_path):
+    _write(tmp_path / "BENCH_measured.json", {
+        "metric": "train_tokens_per_sec_per_chip_580m", "value": 30429.5,
+        "unit": "tokens/s/chip", "vs_baseline": 7.077, "mfu": 0.5964,
+        "measured_at_utc": "2026-07-30T05:48:00Z",
+    })
+    _write(tmp_path / "docs" / "bench" / "2026-07-29_old.json", {
+        "metric": "train_tokens_per_sec_per_chip_580m", "value": 11111.0,
+        "unit": "tokens/s/chip", "vs_baseline": 2.0,
+    })
+    art = bench._cached_tpu_artifact(root=str(tmp_path))
+    assert art["source"] == "BENCH_measured.json"
+    assert art["value"] == 30429.5
+    assert art["provenance"] == "cached"
+    assert art["measured_at"] == "2026-07-30T05:48:00Z"
+
+
+def test_cached_artifact_never_recycles_cached_or_cpu(tmp_path):
+    """A prior wedged round's own output (metric *_cached) and CPU-fallback
+    artifacts must never resurface as the cached on-chip number."""
+    _write(tmp_path / "BENCH_measured.json", {
+        "metric": "train_tokens_per_sec_per_chip_580m_cached", "value": 1.0,
+        "unit": "tokens/s/chip", "vs_baseline": 0.0,
+    })
+    _write(tmp_path / "docs" / "bench" / "a.json", {
+        "metric": "train_tokens_per_sec_per_chip_cpu_fallback", "value": 2.0,
+        "unit": "tokens/s/chip", "vs_baseline": 0.0,
+    })
+    assert bench._cached_tpu_artifact(root=str(tmp_path)) is None
+    # a real measurement behind them is still found
+    _write(tmp_path / "docs" / "bench" / "b_real.json", {
+        "metric": "train_tokens_per_sec_per_chip_580m", "value": 30000.0,
+        "unit": "tokens/s/chip", "vs_baseline": 7.0,
+    })
+    art = bench._cached_tpu_artifact(root=str(tmp_path))
+    assert art is not None and art["value"] == 30000.0
+
+
+def test_cached_artifact_none_when_nothing_exists(tmp_path):
+    assert bench._cached_tpu_artifact(root=str(tmp_path)) is None
+
+
+def test_truncate_keeps_head_and_tail():
+    s = "A" * 5000 + "TAIL"
+    out = bench._truncate(s, 1000)
+    assert len(out) < 1200
+    assert out.startswith("A") and out.endswith("TAIL")
+    assert "truncated" in out
+
+
+def test_sanitize_recurses_and_line_parses():
+    obj = {"a": "x" * 10_000, "b": [{"c": "y" * 10_000}], "n": 3}
+    out = bench._sanitize(obj)
+    line = json.dumps(out)
+    assert len(line) < 10_000
+    assert json.loads(line)["n"] == 3
+
+
+def test_baselines_table_covers_north_star():
+    """The 1.3B north-star scenario must resolve a per-model baseline (a
+    falls-through-to-580m default would overstate vs_baseline)."""
+    assert "1_3b" in bench.BASELINES
+    assert bench.BASELINES["1_3b"] <= bench.BASELINES["580m"]
