@@ -41,6 +41,9 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
+# Ordered prose-first: the byte cap truncates from the END, so natural
+# English survives in full and code fills the remainder (the reference's
+# own training set was a pile + code mix, its data/index names say so).
 TEXT_SOURCES = [
     "/root/repo/*.md",
     "/root/repo/docs/*.md",
@@ -51,6 +54,11 @@ TEXT_SOURCES = [
     "/opt/venv/lib/python3.12/site-packages/**/LICENSE*",
     "/usr/share/doc/**/*.txt",
     "/usr/share/doc/**/copyright",
+    "/usr/share/doc/**/changelog*",  # mostly .gz; gather decompresses
+    "/usr/local/lib/python3.12/*.py",  # stdlib source = the code mix
+    "/usr/local/lib/python3.12/[a-z]*/*.py",
+    # site-packages source (numpy/jax/flax/...) last: the cap bounds it
+    "/opt/venv/lib/python3.12/site-packages/[a-z]*/**/*.py",
 ]
 
 
@@ -59,10 +67,16 @@ def gather_corpus(out_dir: Path, cap_bytes: int, heldout_frac: float = 0.05):
     seen: set = set()
     docs: list[str] = []
     total = 0
-    paths: list[str] = []
-    for pattern in TEXT_SOURCES:
-        paths.extend(sorted(glob.glob(pattern, recursive=True)))
-    for p in paths:
+
+    def iter_paths():
+        # glob lazily per pattern: once the cap is met, later (large, code)
+        # patterns are never even walked — smoke mode stops at the prose
+        for pattern in TEXT_SOURCES:
+            if total >= cap_bytes:
+                return
+            yield from sorted(glob.glob(pattern, recursive=True))
+
+    for p in iter_paths():
         if total >= cap_bytes:
             break
         try:
@@ -214,6 +228,9 @@ def main() -> None:
     ap.add_argument("--out", default="runs/e2e")
     ap.add_argument("--force-cpu", action="store_true",
                     help="pin the cpu platform (smoke defaults to this)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="override training.total_steps (full mode: right-size "
+                         "the on-chip run to the available window)")
     args = ap.parse_args()
 
     out = Path(args.out)
@@ -266,6 +283,15 @@ def main() -> None:
             "--set", "training.log_frequency=10",
             "--set", "optimizer.warmup_steps=10",
             "--set", "checkpoint.save_frequency=60",
+        ]
+    if args.steps is not None:
+        # LAST so it wins in either mode (train.py --set: last occurrence
+        # takes effect). warmup must shrink with the run or the cosine
+        # schedule gets decay_steps <= 0 (config warmup is 200)
+        overrides += [
+            "--set", f"training.total_steps={args.steps}",
+            "--set", f"checkpoint.save_frequency={args.steps}",
+            "--set", f"optimizer.warmup_steps={max(1, min(200, args.steps // 10))}",
         ]
     env = dict(os.environ)
     code = (
