@@ -285,13 +285,17 @@ def main() -> None:
             "--set", "checkpoint.save_frequency=60",
         ]
     if args.steps is not None:
+        if args.steps < 10:
+            raise SystemExit("--steps must be >= 10 (warmup+decay need room)")
         # LAST so it wins in either mode (train.py --set: last occurrence
         # takes effect). warmup must shrink with the run or the cosine
-        # schedule gets decay_steps <= 0 (config warmup is 200)
+        # schedule gets decay_steps <= 0 (config warmup is 200); eval
+        # frequency must shrink too or short runs record no validation loss
         overrides += [
             "--set", f"training.total_steps={args.steps}",
             "--set", f"checkpoint.save_frequency={args.steps}",
             "--set", f"optimizer.warmup_steps={max(1, min(200, args.steps // 10))}",
+            "--set", f"training.evaluation_frequency={max(10, args.steps // 10)}",
         ]
     env = dict(os.environ)
     code = (
