@@ -1,0 +1,103 @@
+"""Poll the TPU tunnel by repeatedly running bench.py until a genuine on-chip
+measurement lands, then promote it to BENCH_measured.json.
+
+The axon TPU tunnel in this image wedges at backend init for hours at a time
+(observed rounds 1-4) and clears on its own. bench.py already handles a wedged
+tunnel gracefully (per-child timeouts, cached-artifact fallback), so the
+cheapest robust watcher is simply: run the full ladder, inspect the artifact,
+retry later if the tunnel was down.
+
+Usage: python scripts/tpu_watch.py [--interval 900] [--max-attempts 0]
+Writes each attempt to runs/bench_attempt_<n>.json (+ .log for stderr) and, on
+success, rewrites BENCH_measured.json with fresh provenance so both the driver
+bench and any later wedged round can ride it.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_once(attempt: int) -> dict | None:
+    """One full bench.py ladder run; returns the parsed artifact or None."""
+    out_path = os.path.join(ROOT, "runs", f"bench_attempt_{attempt}.json")
+    log_path = os.path.join(ROOT, "runs", f"bench_attempt_{attempt}.log")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(log_path, "w") as log:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            cwd=ROOT, stdout=subprocess.PIPE, stderr=log, text=True,
+            timeout=3 * 3600,  # the ladder self-limits; this is a backstop
+        )
+    with open(log_path, "a") as log:  # keep raw stdout diagnosable even if
+        log.write("\n--- stdout ---\n" + proc.stdout)  # the JSON parse fails
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                art = json.loads(line)
+            except json.JSONDecodeError:
+                return None
+            with open(out_path, "w") as f:
+                json.dump(art, f, indent=1)
+            return art
+    return None
+
+
+def is_live_tpu(art: dict) -> bool:
+    metric = str(art.get("metric", ""))
+    if metric.endswith("_cached") or "cpu_fallback" in metric:
+        return False
+    scen = (art.get("extra") or {}).get("scenarios") or {}
+    return any(r.get("ok") and r.get("platform") == "tpu" for r in scen.values())
+
+
+def promote(art: dict) -> None:
+    """Write BENCH_measured.json from the headline scenario of a live run."""
+    art = dict(art)
+    art["measured_at_utc"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    path = os.path.join(ROOT, "BENCH_measured.json")
+    with open(path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"promoted live TPU measurement to {path}", flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=900.0,
+                    help="seconds to sleep between failed attempts")
+    ap.add_argument("--max-attempts", type=int, default=0,
+                    help="0 = retry forever")
+    args = ap.parse_args()
+
+    attempt = 0
+    while True:
+        attempt += 1
+        stamp = datetime.datetime.now().strftime("%H:%M:%S")
+        print(f"[{stamp}] bench attempt {attempt} starting", flush=True)
+        try:
+            art = run_once(attempt)
+        except subprocess.TimeoutExpired:
+            art = None
+            print("attempt hit the 3h backstop timeout", flush=True)
+        if art is not None and is_live_tpu(art):
+            promote(art)
+            print("TPU LIVE — watcher done", flush=True)
+            return
+        errs = ((art or {}).get("extra") or {}).get("errors") or []
+        print(f"no live TPU measurement (errors: {errs[:2]})", flush=True)
+        if args.max_attempts and attempt >= args.max_attempts:
+            print("max attempts reached; giving up", flush=True)
+            return
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()

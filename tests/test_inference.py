@@ -316,6 +316,43 @@ def test_tp2_decode_matches_single_device(devices):
     np.testing.assert_array_equal(np.asarray(out_single), np.asarray(out_tp))
 
 
+def test_tp_kv_cache_indivisible_warns(devices):
+    """ADVICE r4: tp>1 with a KV-head count not divisible by tensor leaves
+    the cache replicated while params are sharded — the HBM win quietly
+    disappears unless init_cache makes the mismatch visible."""
+    import dataclasses
+    import warnings
+
+    from zero_transformer_tpu.inference import serve_mesh
+
+    # GQA with 3 KV heads on a tensor=2 mesh: 3 % 2 != 0
+    cfg = dataclasses.replace(CFG, d_model=48, n_heads=6, n_kv_heads=3)
+    model = decode_model(cfg, 32)
+    mesh = serve_mesh(2)
+    with pytest.warns(UserWarning, match="REPLICATED"):
+        init_cache(model, 2, mesh=mesh)
+    # divisible KV heads: no warning, and the K/V buffers really shard on
+    # the KV-heads dim (dim -2 — under the scanned layer stack the leaves
+    # are 5-D and indexing from the front used to shard the sequence dim)
+    cfg_ok = dataclasses.replace(CFG, n_heads=4, n_kv_heads=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        cache = init_cache(decode_model(cfg_ok, 32), 2, mesh=mesh)
+    from zero_transformer_tpu.parallel.mesh import TENSOR_AXIS
+
+    def kv_entries(tree):
+        return [
+            (p, l) for p, l in jax.tree_util.tree_leaves_with_path(tree)
+            if str(p[-1].key).startswith("cached_")
+        ]
+
+    assert kv_entries(cache), "no KV buffers found in the cache tree"
+    for path, leaf in kv_entries(cache):
+        spec = leaf.sharding.spec
+        assert spec[len(spec) - 2] == TENSOR_AXIS, (path, spec)
+        assert len(leaf.sharding.device_set) == 2, path
+
+
 def test_tp2_prefill_logits_close(devices):
     """TP=2 prefill logits match single-device within float tolerance (the
     reductions are reordered across chips, so bitwise equality is not the

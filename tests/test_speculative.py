@@ -113,6 +113,33 @@ def test_speculative_guards():
     prompt = jnp.zeros((1, 20), jnp.int32)
     with pytest.raises(ValueError, match="cache_len"):
         generate_speculative(model, params, prompt, 10, draft_len=8)
+    # ADVICE r4: temperature<=0 must fail loudly (SamplingConfig parity),
+    # not silently emit inf/NaN-logit garbage
+    short = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="temperature"):
+        generate_speculative(model, params, short, 4, temperature=0.0)
+    with pytest.raises(ValueError, match="temperature"):
+        generate_speculative(model, params, short, 4, temperature=-1.0)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        generate_speculative(model, params, short, 4, repetition_penalty=0.0)
+
+
+def test_speculative_temperature_shares_one_executable():
+    """ADVICE r4: temperature is a traced operand of the decode loop — a
+    serving knob must not trigger a full recompile per distinct value."""
+    from zero_transformer_tpu.inference.speculative import _spec_loop
+
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(6).integers(1, 64, (1, 12)), jnp.int32
+    )
+    generate_speculative(model, params, prompt, 8, draft_len=4, temperature=0.7)
+    misses0 = _spec_loop._cache_size()
+    for t in (0.8, 0.9, 1.1):
+        generate_speculative(
+            model, params, prompt, 8, draft_len=4, temperature=t
+        )
+    assert _spec_loop._cache_size() == misses0
 
 
 def test_speculative_learned_positions_guard():

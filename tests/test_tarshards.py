@@ -255,8 +255,11 @@ def _npy_bytes(row):
 
 def test_index_cwd_relative_fallback(tmp_path, monkeypatch):
     """Legacy index whose relative entries were written against the training
-    job's cwd (pre-round-3 semantics): when the index-relative candidate
-    does not exist but the cwd-relative one does, the cwd one is used."""
+    job's cwd (pre-round-3 semantics). The fallback is OPT-IN (ADVICE r4):
+    by default an entry that exists only cwd-relative raises loudly — a
+    partially-copied dataset plus a same-layout dataset in the cwd must not
+    silently train on the wrong shards — and the flag / env var restores
+    the legacy resolution."""
     from zero_transformer_tpu.data.tarshards import read_index
 
     idx_dir = tmp_path / "indexes"
@@ -267,8 +270,15 @@ def test_index_cwd_relative_fallback(tmp_path, monkeypatch):
     cwd_shard.parent.mkdir()
     cwd_shard.write_bytes(b"")
     monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("ZT_INDEX_CWD_FALLBACK", raising=False)
+    with pytest.raises(ValueError, match="cwd-relative"):
+        read_index(idx)  # ambiguous by default: fail loudly
+    assert read_index(idx, legacy_cwd_fallback=True) == ["shards/part-0.tar"]
+    monkeypatch.setenv("ZT_INDEX_CWD_FALLBACK", "1")
     assert read_index(idx) == ["shards/part-0.tar"]
-    # index-relative wins once it exists (the modern layout)
+    # index-relative wins once it exists (the modern layout) — no opt-in
+    # needed and none consulted
+    monkeypatch.delenv("ZT_INDEX_CWD_FALLBACK")
     new_shard = idx_dir / "shards" / "part-0.tar"
     new_shard.parent.mkdir()
     new_shard.write_bytes(b"")

@@ -32,6 +32,7 @@ import gzip
 import io
 import json
 import logging
+import os
 import re
 import tarfile
 from pathlib import Path
@@ -60,7 +61,9 @@ def expand_braces(pattern: str) -> List[str]:
     return out
 
 
-def read_index(path: str | Path) -> List[str]:
+def read_index(
+    path: str | Path, legacy_cwd_fallback: bool | None = None
+) -> List[str]:
     """Index file → expanded shard list (reference ``data/index/*.index``).
 
     Relative local entries resolve against the index file's OWN directory —
@@ -69,11 +72,16 @@ def read_index(path: str | Path) -> List[str]:
     cwd. Absolute paths and remote URLs (``gs://…``) pass through verbatim.
 
     Compat: before round 3 relative entries resolved against the process
-    cwd. An index whose entries only exist relative to the cwd still loads
-    — the cwd-relative candidate is used as FALLBACK when the
-    index-relative path does not exist — but new indexes should be written
-    next to their shards.
+    cwd. That fallback is OPT-IN (``legacy_cwd_fallback=True`` or env
+    ``ZT_INDEX_CWD_FALLBACK=1``): a partially-copied dataset plus a
+    same-layout dataset in the cwd must fail loudly by default, not train
+    on the wrong shards behind a warning that scrolls away (the non-strict
+    tar source would otherwise skip the missing shards at open time and
+    quietly reshape the stream). Without the opt-in, an entry that exists
+    only cwd-relative raises with the remedy in the message.
     """
+    if legacy_cwd_fallback is None:
+        legacy_cwd_fallback = os.environ.get("ZT_INDEX_CWD_FALLBACK") == "1"
     base = Path(path).parent
     shards: List[str] = []
     for line in Path(path).read_text().splitlines():
@@ -84,14 +92,19 @@ def read_index(path: str | Path) -> List[str]:
             if "://" not in s and not Path(s).is_absolute():
                 resolved = base / s
                 if not resolved.exists() and Path(s).exists():
-                    import logging
-
-                    # loud: a partially-copied dataset with a same-layout
-                    # dataset in the cwd would otherwise silently train on
-                    # the wrong shards
-                    logging.getLogger(__name__).warning(
-                        "index entry %r missing at %s; falling back to the "
-                        "legacy cwd-relative path %s",
+                    if not legacy_cwd_fallback:
+                        raise ValueError(
+                            f"index entry {s!r} missing at {resolved} but "
+                            f"present cwd-relative at {Path(s).resolve()} — "
+                            "refusing to guess which dataset you meant. "
+                            "Move/complete the dataset next to the index, "
+                            "or opt in to the legacy cwd resolution with "
+                            "read_index(..., legacy_cwd_fallback=True) / "
+                            "ZT_INDEX_CWD_FALLBACK=1"
+                        )
+                    log.warning(
+                        "index entry %r missing at %s; using the legacy "
+                        "cwd-relative path %s (ZT_INDEX_CWD_FALLBACK)",
                         s, resolved, Path(s).resolve(),
                     )
                     resolved = Path(s)  # legacy cwd-relative index entry

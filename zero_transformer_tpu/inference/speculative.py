@@ -54,15 +54,17 @@ def _set_cache_index(cache: Any, value: jax.Array) -> Any:
     return jax.tree_util.tree_map_with_path(one, cache)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _spec_loop(
     model: Transformer,
     max_new: int,
     K: int,
     eos_token_id: int,
     pad_token_id: int,
-    penalty: float,  # repetition penalty (1.0 = off; emulated in acceptance)
-    temperature: float,  # mirrored bit-exactly from the plain path: FP
+    penalty: float,  # repetition penalty (1.0 = off; emulated in acceptance;
+    # static — it selects the vectorized vs sequential acceptance branch)
+    temperature: jax.Array,  # traced f32 scalar (a serving knob: every value
+    # sharing one executable). Mirrored bit-exactly from the plain path: FP
     # division can collapse two near-equal logits into a tie and flip the
     # argmax, so "temperature never changes the argmax" holds in real
     # arithmetic but not in float32 — we apply the SAME transform instead
@@ -218,6 +220,14 @@ def generate_speculative(
     K = int(draft_len)
     if K < 1:
         raise ValueError("draft_len must be >= 1")
+    if not temperature > 0:
+        # mirror SamplingConfig.__post_init__: a direct API call with
+        # temperature<=0 must fail loudly, not emit inf/NaN-logit garbage
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    if not repetition_penalty > 0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}"
+        )
     cache_len = model.cache_len or model.cfg.max_seq_len
     # worst case writes K+1 slots starting at T0 + max_new - 1
     if T0 + max_new_tokens + K > cache_len:
@@ -249,7 +259,8 @@ def generate_speculative(
     out, n_fwd, n_emitted = _spec_loop(
         model, int(max_new_tokens), K,
         -1 if eos_token_id is None else int(eos_token_id), int(pad_token_id),
-        float(repetition_penalty), float(temperature),
+        float(repetition_penalty),
+        jnp.asarray(float(temperature), jnp.float32),
         params, hist, jnp.asarray(T0, jnp.int32), c0, gen_mask0, cache,
     )
     if return_stats:
