@@ -30,15 +30,29 @@ def run_once(attempt: int) -> dict | None:
     out_path = os.path.join(ROOT, "runs", f"bench_attempt_{attempt}.json")
     log_path = os.path.join(ROOT, "runs", f"bench_attempt_{attempt}.log")
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    # bench.py spawns each scenario as its own subprocess: run the whole
+    # tree in a new session so the backstop kill reaps the grandchildren
+    # too — an orphaned scenario child would keep the TPU tunnel held,
+    # recreating the very wedge this watcher exists to outlast
     with open(log_path, "w") as log:
-        proc = subprocess.run(
+        popen = subprocess.Popen(
             [sys.executable, os.path.join(ROOT, "bench.py")],
             cwd=ROOT, stdout=subprocess.PIPE, stderr=log, text=True,
-            timeout=3 * 3600,  # the ladder self-limits; this is a backstop
+            start_new_session=True,
         )
+        try:
+            stdout, _ = popen.communicate(
+                timeout=3 * 3600  # the ladder self-limits; this is a backstop
+            )
+        except subprocess.TimeoutExpired as e:
+            import signal
+
+            os.killpg(popen.pid, signal.SIGKILL)
+            stdout = e.stdout or ""
+            popen.wait()
     with open(log_path, "a") as log:  # keep raw stdout diagnosable even if
-        log.write("\n--- stdout ---\n" + proc.stdout)  # the JSON parse fails
-    for line in reversed(proc.stdout.strip().splitlines()):
+        log.write("\n--- stdout ---\n" + (stdout or ""))  # the parse fails
+    for line in reversed((stdout or "").strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:

@@ -99,8 +99,8 @@ def read_index(
                             "refusing to guess which dataset you meant. "
                             "Move/complete the dataset next to the index, "
                             "or opt in to the legacy cwd resolution with "
-                            "read_index(..., legacy_cwd_fallback=True) / "
-                            "ZT_INDEX_CWD_FALLBACK=1"
+                            "legacy_cwd_fallback=True (TarShardSource / "
+                            "read_index) or ZT_INDEX_CWD_FALLBACK=1"
                         )
                     log.warning(
                         "index entry %r missing at %s; using the legacy "
@@ -153,6 +153,10 @@ class TarShardSource(ReplayStreamSource):
       stripe_shards: "auto" stripes at shard granularity when every process
         can own >= 2 shards (per-host IO then scales 1/P instead of every
         host decompressing every shard); True forces it, False disables.
+      legacy_cwd_fallback: resolve index entries that only exist relative to
+        the process cwd (pre-round-3 index layout) instead of raising; None
+        (default) reads the ZT_INDEX_CWD_FALLBACK env var. See
+        ``read_index``.
       strict: False (default) logs and skips undecodable members / unreadable
         shards instead of crashing a multi-day run on one bad byte — the
         reference's ``wds.warn_and_continue`` semantics (reference
@@ -180,6 +184,7 @@ class TarShardSource(ReplayStreamSource):
         process_count: int = 1,
         stripe_shards: bool | str = "auto",
         strict: bool = False,
+        legacy_cwd_fallback: bool | None = None,
     ):
         if isinstance(shards, (str, Path)):
             shards = [str(shards)]
@@ -187,7 +192,7 @@ class TarShardSource(ReplayStreamSource):
         for s in shards:
             s = str(s)
             if s.endswith(".index"):
-                expanded.extend(read_index(s))
+                expanded.extend(read_index(s, legacy_cwd_fallback))
             else:
                 expanded.extend(expand_braces(s))
         if not expanded:

@@ -28,7 +28,10 @@ from jax.nn import initializers
 
 from zero_transformer_tpu.config import ModelConfig, resolve_dtype
 from zero_transformer_tpu.models.moe import MoEMLP
-from zero_transformer_tpu.parallel.sharding import constrain_activation
+from zero_transformer_tpu.parallel.sharding import (
+    constrain_activation,
+    replicate_activation,
+)
 from zero_transformer_tpu.ops.attention import dot_product_attention, xla_attention
 from zero_transformer_tpu.ops.losses import next_token_loss
 from zero_transformer_tpu.ops.positions import apply_rope
@@ -302,7 +305,24 @@ class Transformer(nn.Module):
             param_dtype=param_dtype,
             name="wte",
         )
-        h = constrain_activation(embed(x), "batch", "seq", "embed")
+        if self.decode:
+            # decode gathers [B, <=few] ids per step; replicating the table
+            # inside the decode while_loop would all-gather it every token
+            h = embed(x)
+        else:
+            # Token lookup runs on an explicitly REPLICATED view of the
+            # table: with wte sharded over vocab (tensor) and/or embed
+            # (ZeRO-3), the gather output inherits an embed-sharded layout
+            # that GSPMD can only reshard to the batch/seq activation layout
+            # via "[SPMD] Involuntary full rematerialization" (round-4
+            # MULTICHIP finding). One up-front all-gather is the efficient
+            # form of the same data movement — and matches the reference's
+            # trivially-replicated wte (reference ``src/models/GPT.py:75-83``).
+            # The tied head (``embed.attend``) still consumes the sharded
+            # table, so the vocab-parallel logits matmul is unaffected.
+            table = replicate_activation(jnp.asarray(embed.embedding, dtype))
+            h = jnp.take(table, x, axis=0)
+        h = constrain_activation(h, "batch", "seq", "embed")
 
         if cfg.position == "learned":
             if T > cfg.max_seq_len:

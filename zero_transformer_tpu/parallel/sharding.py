@@ -250,5 +250,20 @@ def constrain_activation(x: jax.Array, *names: Optional[str]) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, P(*spec))
 
 
+def replicate_activation(x: jax.Array) -> jax.Array:
+    """Constrain ``x`` to full replication over the ambient auto mesh.
+
+    ``constrain_activation`` cannot express this (an all-``None`` spec is its
+    no-op case); this is an explicit "materialize the whole tensor on every
+    chip HERE" — used for the embedding-table view feeding the token gather,
+    where one up-front all-gather beats the involuntary full
+    rematerialization GSPMD otherwise inserts on the gather output. No-op
+    without an ambient mesh."""
+    amesh = jax.sharding.get_abstract_mesh()
+    if amesh is None or not amesh.axis_names:
+        return x
+    return jax.lax.with_sharding_constraint(x, P(*(None,) * x.ndim))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
