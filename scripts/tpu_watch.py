@@ -48,8 +48,14 @@ def run_once(attempt: int) -> dict | None:
             import signal
 
             os.killpg(popen.pid, signal.SIGKILL)
-            stdout = e.stdout or ""
+            # TimeoutExpired.stdout is BYTES even under text=True (CPython
+            # joins the raw chunks, gh-87597)
+            stdout = e.stdout or b""
+            if isinstance(stdout, bytes):
+                stdout = stdout.decode(errors="replace")
             popen.wait()
+            print("attempt hit the 3h backstop timeout; killed the "
+                  "bench process group", flush=True)
     with open(log_path, "a") as log:  # keep raw stdout diagnosable even if
         log.write("\n--- stdout ---\n" + (stdout or ""))  # the parse fails
     for line in reversed((stdout or "").strip().splitlines()):
@@ -96,11 +102,7 @@ def main() -> None:
         attempt += 1
         stamp = datetime.datetime.now().strftime("%H:%M:%S")
         print(f"[{stamp}] bench attempt {attempt} starting", flush=True)
-        try:
-            art = run_once(attempt)
-        except subprocess.TimeoutExpired:
-            art = None
-            print("attempt hit the 3h backstop timeout", flush=True)
+        art = run_once(attempt)  # handles the backstop timeout internally
         if art is not None and is_live_tpu(art):
             promote(art)
             print("TPU LIVE — watcher done", flush=True)
