@@ -13,6 +13,7 @@ Usage:
   python -m zero_transformer_tpu.export extend   --params params.msgpack --layers 24 --out big.msgpack
   python -m zero_transformer_tpu.export inspect  --params params.msgpack
   python -m zero_transformer_tpu.export import-reference --params ref.msgpack --model 1_3b --out ours.msgpack
+  python -m zero_transformer_tpu.export to-reference --params ours.msgpack --model 1_3b --out ref.msgpack
 """
 from __future__ import annotations
 
@@ -86,6 +87,103 @@ def convert_reference_params(ref: dict, scan_layers: bool = True) -> dict:
         for dst, arrs in stacked.items():
             for i, a in enumerate(arrs):
                 out[(f"block_{i}",) + dst] = a
+    return unflatten_dict(out)
+
+
+def convert_to_reference_params(params: dict) -> dict:
+    """This framework's param tree -> the reference's extracted-params
+    layout (exact inverse of ``convert_reference_params``; round-tripping
+    through it is the identity, tested).
+
+    Completes the interchange symmetry: the reference exports its
+    checkpoints outward (``torch_compatability/flax_to_pytorch.py:70-117``);
+    this writes OUR checkpoints into the reference's msgpack layout —
+    torch-free, loadable by the reference's own flax tooling.
+
+    Only the reference's architecture family converts (GPT-2+ALiBi: tied
+    embeddings, scale-only norms, bias-free square attention, dense
+    gelu MLP). Leaves with no reference counterpart (swiglu gate, untied
+    lm_head, MoE experts, learned-position wpe) raise — a silent drop
+    would write a checkpoint that loads but computes a different function.
+    NOTE the layout alone cannot distinguish RMSNorm from LayerNorm (both
+    store one ``scale``); use the CLI's ``--model`` check (or your own
+    config) to guard that.
+    """
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    params = dict(params.get("params", params))
+    inv = {dst: src for src, dst in _REF_BLOCK_MAP.items()}
+    flat = {k: np.asarray(v) for k, v in flatten_dict(params).items()}
+
+    out: dict = {}
+    consumed = set()
+    for src, dst in (
+        (("wte", "embedding"), ("wte", "embedding")),
+        (("ln_f", "scale"), ("LayerNorm_0", "scale")),
+    ):
+        if src not in flat:
+            raise ValueError(f"params tree has no {'/'.join(src)} leaf")
+        out[dst] = flat[src]
+        consumed.add(src)
+
+    per_block: dict = {}
+
+    def emit(i: int, sub: tuple, arr: np.ndarray) -> None:
+        src = inv.get(sub)
+        if src is None:
+            raise ValueError(
+                f"block leaf {'/'.join(sub)} has no reference counterpart "
+                "(the reference family is GPT-2+ALiBi: tied embeddings, "
+                "scale-only norms, dense gelu MLP)"
+            )
+        out[(f"TransformerBlock_{i}",) + src] = arr
+        per_block.setdefault(i, set()).add(sub)
+
+    n_layers = 0
+    if any(k[0] == "blocks" for k in flat):  # stacked nn.scan layout
+        for key, arr in flat.items():
+            if key[0] != "blocks":
+                continue
+            for i in range(arr.shape[0]):
+                emit(i, key[1:], arr[i])
+            n_layers = max(n_layers, arr.shape[0])
+            consumed.add(key)
+    else:  # per-block layout
+        for key, arr in flat.items():
+            if not key[0].startswith("block_"):
+                continue
+            i = int(key[0].rsplit("_", 1)[1])
+            emit(i, key[1:], arr)
+            n_layers = max(n_layers, i + 1)
+            consumed.add(key)
+    if n_layers == 0:
+        raise ValueError("no blocks/block_i entries: not this framework's params tree")
+    # per-block completeness: MISSING leaves (a truncated tree, a gap in the
+    # block_i indices) must raise like extra ones do — an incomplete
+    # reference checkpoint would load and compute a different function
+    for i in range(n_layers):
+        gap = set(inv) - per_block.get(i, set())
+        if gap:
+            names = sorted("/".join(s) for s in gap)
+            raise ValueError(f"block {i}: missing leaves {names}")
+
+    leftovers = set(flat) - consumed
+    if leftovers:
+        names = sorted("/".join(k) for k in leftovers)
+        raise ValueError(
+            f"leaves with no reference counterpart: {names} — only the "
+            "GPT-2+ALiBi family (tied head, dense MLP) exports to the "
+            "reference layout"
+        )
+    d = out[("wte", "embedding")].shape[1]
+    for i in range(n_layers):
+        for proj in ("query_proj", "key_proj", "value_proj", "residual_out"):
+            shape = out[(f"TransformerBlock_{i}", "CausalAttention_0", proj, "kernel")].shape
+            if shape != (d, d):
+                raise ValueError(
+                    f"TransformerBlock_{i}/{proj} kernel {shape} is not square "
+                    f"[{d},{d}] — GQA/MQA models have no reference counterpart"
+                )
     return unflatten_dict(out)
 
 
@@ -179,6 +277,52 @@ def _cmd_import_reference(args) -> None:
     print(f"converted {n:,} reference params ({args.model}) -> {args.out}")
 
 
+def _cmd_to_reference(args) -> None:
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.checkpoint import import_params_msgpack
+
+    params = import_params_msgpack(args.params)
+    if args.model:
+        from zero_transformer_tpu.config import model_config
+
+        cfg = model_config(args.model)
+        bad = [
+            f"{field}={got!r} (reference: {want!r})"
+            for field, got, want in (
+                ("norm", cfg.norm, "layernorm"),
+                ("position", cfg.position, "alibi"),
+                ("activation", cfg.activation, "gelu"),
+                ("tie_embeddings", cfg.tie_embeddings, True),
+            )
+            if got != want
+        ]
+        if bad:
+            raise SystemExit(
+                f"{args.model} is outside the reference family: {'; '.join(bad)}"
+            )
+    # unwrap once HERE: the converter tolerates an outer "params" wrapper,
+    # so the layout detection and round-trip comparison below must see the
+    # same unwrapped tree it converts
+    params = dict(params.get("params", params))
+    ref = convert_to_reference_params(params)
+    # round-trip safety: the emitted layout must read back to the SAME tree
+    # through the importer — the two maps must stay exact inverses. A real
+    # check, not an assert: it must survive python -O
+    back = convert_reference_params(
+        ref, scan_layers=any(k == "blocks" for k in params)
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(back)[0],
+    ):
+        if pa != pb or not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise SystemExit(f"round-trip mismatch at {pa}: refusing to write")
+    Path(args.out).write_bytes(msgpack_serialize(ref))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(ref))
+    print(f"wrote {n:,} params in reference layout -> {args.out}")
+
+
 def _cmd_inspect(args) -> None:
     from zero_transformer_tpu.checkpoint import import_params_msgpack
     from zero_transformer_tpu.utils.surgery import is_stacked, num_layers
@@ -223,6 +367,19 @@ def main(argv=None) -> None:
     ins = sub.add_parser("inspect", help="list tensors in a params msgpack")
     ins.add_argument("--params", required=True)
     ins.set_defaults(fn=_cmd_inspect)
+
+    tr = sub.add_parser(
+        "to-reference",
+        help="this framework's params msgpack -> the reference's "
+             "extracted-params layout (inverse of import-reference, "
+             "round-trip-verified)",
+    )
+    tr.add_argument("--params", required=True)
+    tr.add_argument("--model", default=None,
+                    help="optional zoo name: reject configs outside the "
+                         "reference family (rmsnorm/rope/swiglu/untied)")
+    tr.add_argument("--out", required=True)
+    tr.set_defaults(fn=_cmd_to_reference)
 
     ir = sub.add_parser(
         "import-reference",
