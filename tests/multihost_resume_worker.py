@@ -1,0 +1,142 @@
+"""Worker for the 4-process kill+resume test (test_multihost.py).
+
+The crash-recovery story the reference left manual (reference
+``src/utils/pod_test.py:1-6`` "run this before training to check the pod";
+recovery after a mid-run host loss meant restarting the job by hand,
+``main_zero.py:291-313`` restore branch), driven end-to-end across REAL
+process boundaries:
+
+- ``straight``  — 4 processes train steps 1-4; steps 3-4 losses are the
+  ground truth.
+- ``interrupted`` — 4 processes train steps 1-2, write a (periodic)
+  checkpoint, then process 3 dies abruptly (``os._exit`` — a host crash,
+  no goodbye to the coordinator). The survivors attempt step 3 anyway: the
+  collective can never complete with a dead member, so a watchdog converts
+  the stall into a documented exit code instead of a silent hang.
+- ``resume``    — a FRESH 4-process job restores the checkpoint (sharded,
+  every host reads only its pieces), restores the loader position, and
+  trains steps 3-4. Its losses must equal ``straight``'s exactly — the
+  interruption is invisible in the trajectory.
+
+Prints ``LOSS step=N <loss>`` lines and ``WORKER_OK`` on success.
+"""
+import os
+import sys
+import threading
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+)
+# persistent compile cache (same default as tests/conftest.py): three phases
+# x four processes compile the SAME programs — without this the test's
+# wall-clock is ~12 identical XLA compiles
+_cache_dir = os.path.expanduser(
+    os.environ.get("JAX_TEST_COMPILATION_CACHE", "/tmp/zero_transformer_tpu_jax_cache")
+)
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from zero_transformer_tpu.parallel.bootstrap import maybe_initialize  # noqa: E402
+
+VICTIM = 3  # the process that "loses its host" in interrupted mode
+
+
+def main():
+    mode = os.environ["WORKER_MODE"]
+    assert maybe_initialize(), "coordinator env vars must trigger initialization"
+    assert jax.process_count() == 4, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from zero_transformer_tpu import checkpoint as ckpt_lib
+    from zero_transformer_tpu.config import MeshConfig, OptimizerConfig, model_config
+    from zero_transformer_tpu.data import DataLoader, SyntheticSource, device_put_batch
+    from zero_transformer_tpu.models.gpt import Transformer
+    from zero_transformer_tpu.parallel.mesh import make_mesh
+    from zero_transformer_tpu.parallel.zero import (
+        init_train_state,
+        make_plan,
+        make_train_step,
+    )
+    from zero_transformer_tpu.training.optimizer import make_optimizer
+
+    cfg = model_config("test", dropout=0.0)
+    mesh = make_mesh(MeshConfig(zero_stage=2))
+    model = Transformer(cfg)
+    tx = make_optimizer(OptimizerConfig(warmup_steps=2, total_steps=10))
+
+    batch_size, seq = 8, 32
+    plan = make_plan(model, tx, mesh, (batch_size, seq), zero_stage=2)
+    state = init_train_state(
+        model, tx, jax.random.PRNGKey(0), mesh, (batch_size, seq), plan
+    )
+    step = make_train_step(model, tx, mesh, plan, zero_stage=2)
+
+    def fresh_loader():
+        return DataLoader(
+            SyntheticSource(cfg.vocab_size, seq, seed=1),
+            batch_size=batch_size,
+            train_context=seq,
+        )
+
+    loader = fresh_loader()
+    batch_sharding = NamedSharding(mesh, P(None, *plan.batch.spec))
+    rng = jax.random.PRNGKey(2)
+    mgr = ckpt_lib.CheckpointManager(
+        os.environ["WORKER_CKPT_DIR"], keep=2, async_save=False
+    )
+
+    def run_steps(it, state, n):
+        for _ in range(n):
+            batch = device_put_batch(next(it), batch_sharding)
+            state, metrics = step(state, batch, rng)
+            loss = float(metrics["loss"])
+            assert loss == loss, "non-finite loss"
+            print(f"LOSS step={int(state.step)} {loss:.10f}", flush=True)
+        return state
+
+    if mode == "resume":
+        abstract = jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+            jax.eval_shape(lambda s: s, state),
+            plan.state,
+        )
+        state, meta = mgr.restore(abstract)
+        assert int(state.step) == 2, int(state.step)
+        loader.restore(meta["loader"])
+        state = run_steps(iter(loader), state, 2)
+    else:  # straight / interrupted
+        it = iter(loader)
+        state = run_steps(it, state, 2)
+        mgr.save(2, state, meta={"loader": loader.state()}, force=True)
+        mgr.wait()
+        print("SAVED step=2", flush=True)
+        if mode == "interrupted":
+            if jax.process_index() == VICTIM:
+                os._exit(9)  # host crash: no cleanup, no coordinator goodbye
+            # survivors attempt the next step; with a dead member the
+            # collective cannot complete — the watchdog documents the stall
+            threading.Timer(90.0, lambda: os._exit(7)).start()
+            try:
+                run_steps(it, state, 1)
+                print("SURVIVOR_STEP_COMPLETED_UNEXPECTEDLY", flush=True)
+            except Exception as e:  # distributed runtime noticed the death
+                print(f"SURVIVOR_ERROR {type(e).__name__}", flush=True)
+            os._exit(7)
+        else:
+            state = run_steps(it, state, 2)
+
+    mgr.close()
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -152,7 +152,13 @@ def convert_to_reference_params(params: dict) -> dict:
         for key, arr in flat.items():
             if not key[0].startswith("block_"):
                 continue
-            i = int(key[0].rsplit("_", 1)[1])
+            suffix = key[0].rsplit("_", 1)[1]
+            if not suffix.isdigit():
+                raise ValueError(
+                    f"top-level entry {key[0]!r} is not a block_<i> layer "
+                    "of this framework's per-block layout"
+                )
+            i = int(suffix)
             emit(i, key[1:], arr)
             n_layers = max(n_layers, i + 1)
             consumed.add(key)
@@ -316,7 +322,9 @@ def _cmd_to_reference(args) -> None:
         jax.tree_util.tree_flatten_with_path(params)[0],
         jax.tree_util.tree_flatten_with_path(back)[0],
     ):
-        if pa != pb or not np.array_equal(np.asarray(a), np.asarray(b)):
+        if pa != pb or not np.array_equal(
+            np.asarray(a), np.asarray(b), equal_nan=True
+        ):  # equal_nan: a diverged run's NaN weights still convert exactly
             raise SystemExit(f"round-trip mismatch at {pa}: refusing to write")
     Path(args.out).write_bytes(msgpack_serialize(ref))
     n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(ref))
