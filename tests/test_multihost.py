@@ -108,6 +108,11 @@ def test_four_process_kill_and_resume(tmp_path):
         assert "SAVED step=2" in outs[i], f"survivor {i} never saved:\n{outs[i]}"
         # a job with a dead member must NOT complete the next step
         assert "SURVIVOR_STEP_COMPLETED_UNEXPECTEDLY" not in outs[i], outs[i]
+        # ...and must exit through the worker's own watchdog/error path
+        # (rc 7), not hang until the harness deadline kills it
+        assert procs[i].returncode == 7, (
+            f"survivor {i} rc={procs[i].returncode}:\n{outs[i]}"
+        )
 
     # phase 3: fresh job restores and continues
     env["WORKER_MODE"] = "resume"

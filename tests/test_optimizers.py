@@ -86,19 +86,35 @@ def test_adafactor_state_is_factored(devices):
     assert af < 0.6 * n_params, f"adafactor state {af} vs params {n_params}"
 
 
-def test_adafactor_rejected_at_zero2():
-    from zero_transformer_tpu.config import Config, TrainingConfig
+def test_adafactor_trains_at_zero2(tmp_path):
+    """Adafactor x ZeRO-2 through the Trainer (pre-round-5 this combination
+    was rejected; the explicit core now swaps in the shard-aware factored
+    transforms via tx_factory). Loss must fall and stay finite — the
+    trajectory-vs-stage-1 exactness lives in test_zero.py."""
+    from zero_transformer_tpu.config import (
+        CheckpointConfig, Config, DataConfig, TrainingConfig,
+    )
     from zero_transformer_tpu.training.trainer import Trainer
 
     cfg = Config(
-        model=CFG,
+        model=dataclasses.replace(CFG, d_model=128),  # >=128 so factoring fires
         mesh=MeshConfig(zero_stage=2),
-        optimizer=OptimizerConfig(warmup_steps=2, total_steps=8,
-                                  optimizer="adafactor"),
-        training=TrainingConfig(batch_size=8, train_context=16, total_steps=8),
+        optimizer=OptimizerConfig(peak_learning_rate=3e-2, warmup_steps=2,
+                                  total_steps=20, optimizer="adafactor"),
+        training=TrainingConfig(batch_size=8, train_context=16, total_steps=20,
+                                evaluation_frequency=100, log_frequency=100),
+        data=DataConfig(source="synthetic", max_context=16),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "run"),
+                                    save_frequency=100, async_save=False),
     )
-    with pytest.raises(ValueError, match="adafactor does not compose"):
-        Trainer(cfg)
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    first_eval = trainer.evaluate(state)["loss"]
+    state = trainer.train()
+    final_eval = trainer.evaluate(state)["loss"]
+    trainer.close()
+    assert np.isfinite(final_eval)
+    assert final_eval < first_eval, (first_eval, final_eval)
 
 
 def test_invalid_family_rejected():

@@ -128,6 +128,28 @@ def test_pp_rejects_zero3_and_indivisible(devices):
         make_train_step(model, tx, mesh_tp, plan_tp, 1)
 
 
+def test_pp_adafactor_zero2_rejected(devices):
+    """Adafactor (factored stats) is ZeRO-axis-aware but not pipe-aware:
+    pipe x stage>=2 must reject with the reason, not die in an internal
+    shard_map assertion (r5 review finding). Stage <= 1 pipe adafactor and
+    non-pipe adafactor x ZeRO-2/3 both work."""
+    mesh = make_mesh(MeshConfig(pipe=2, data=4))
+    model = Transformer(CFG)
+    opt_af = dataclasses.replace(OPT, optimizer="adafactor")
+    tx = make_optimizer(opt_af)
+    plan = make_plan(model, tx, mesh, (2, 16), 2)
+    with pytest.raises(NotImplementedError, match="adafactor"):
+        make_train_step(
+            model, tx, mesh, plan, 2,
+            tx_factory=lambda norm_fn, zc=None: make_optimizer(
+                opt_af, None, norm_fn, zero_collectives=zc
+            ),
+        )
+    # plain 1-arg factory (un-sharded adafactor) is rejected the same way
+    with pytest.raises(NotImplementedError, match="adafactor"):
+        make_train_step(model, tx, mesh, plan, 2)
+
+
 def test_pp_packed_matches_dp_trajectory(devices):
     """Packed-sequence training through the pipeline wavefront: every rank
     derives the microbatch's document ids from the (pipe-replicated) batch,

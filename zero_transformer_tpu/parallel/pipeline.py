@@ -486,7 +486,33 @@ def _pp_zero2_step(
             total = total + s
         return jnp.sqrt(total)
 
-    tx_inner = tx_factory(pp_shard_norm) if tx_factory is not None else tx
+    from zero_transformer_tpu.parallel.zero import apply_tx_factory
+
+    tx_inner = (
+        apply_tx_factory(tx_factory, pp_shard_norm, zc)
+        if tx_factory is not None
+        else tx
+    )
+    probe_state = jax.eval_shape(  # structure-only: nothing materializes
+        tx_inner.init, {"w": jax.ShapeDtypeStruct((4, 4), jnp.float32)}
+    )
+    if any(
+        isinstance(s, optax.FactoredState)
+        for s in jax.tree.leaves(
+            probe_state, is_leaf=lambda x: isinstance(x, optax.FactoredState)
+        )
+    ):
+        # The sharded factored stats are ZeRO-axis-aware but not PIPE-aware:
+        # pipe-stacked leaves' stats are stage-local [L/P, ...] inside the
+        # manual region while the plan stores them replicated at the global
+        # shape — a trace-time shape clash (and fixing it needs pipe-sharded
+        # opt-state specs for the stat trees). Reject with the reason rather
+        # than dying in an internal shard_map assertion.
+        raise NotImplementedError(
+            "adafactor does not compose with pipeline x ZeRO>=2 (factored "
+            "stats are not pipe-aware); use adamw/lion with pipe at stage 2, "
+            "or adafactor with pipe at stage <= 1"
+        )
 
     def core(state: TrainState, batch: jax.Array, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)

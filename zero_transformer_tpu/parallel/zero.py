@@ -264,6 +264,24 @@ def _zero_scatter_dim(spec: P, zaxes: tuple) -> int:
     return -1
 
 
+def apply_tx_factory(tx_factory, norm_fn, zc):
+    """Call ``tx_factory(norm_fn[, zc])``. The optional second argument hands
+    the manual core's ``ZeroCollectives`` to optimizers that need shard-aware
+    transforms beyond the clip norm (sharded adafactor); single-argument
+    factories (the original contract) keep working unchanged."""
+    import inspect
+
+    try:
+        n_pos = sum(
+            1
+            for p in inspect.signature(tx_factory).parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        )
+    except (TypeError, ValueError):
+        n_pos = 1
+    return tx_factory(norm_fn, zc) if n_pos >= 2 else tx_factory(norm_fn)
+
+
 class ZeroCollectives:
     """The hand-placed ZeRO collective schedule, reusable by any partial-
     manual core whose manual axes include the ZeRO (data/fsdp) axes — the
@@ -364,7 +382,11 @@ def _make_explicit_zero_step(
     zc = ZeroCollectives(mesh, plan)
     zaxes, axis = zc.zaxes, zc.axis
 
-    tx_inner = tx_factory(zc.shard_norm) if tx_factory is not None else tx
+    tx_inner = (
+        apply_tx_factory(tx_factory, zc.shard_norm, zc)
+        if tx_factory is not None
+        else tx
+    )
 
     def loss_fn(params, micro, rng):
         _, loss = model.apply(

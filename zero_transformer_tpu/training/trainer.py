@@ -50,16 +50,6 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
         self.zero_stage = cfg.mesh.zero_stage
-        if cfg.optimizer.optimizer == "adafactor" and self.zero_stage >= 2:
-            # factored row/col stats are replicated by the sharding plan but
-            # the explicit ZeRO-2/3 core feeds the update gradient SHARDS —
-            # shape error deep in optax; fail with the real reason instead
-            raise ValueError(
-                "adafactor does not compose with ZeRO stage >= 2 (factored "
-                "stats vs sharded grads); use zero_stage <= 1 — adafactor "
-                "already removes the optimizer-memory pressure"
-            )
-
         opt = dataclasses.replace(cfg.optimizer, total_steps=cfg.training.total_steps)
         # an active sequence axis routes attention through the ring-attention
         # context-parallel path (ops/ring_attention.py)
@@ -83,7 +73,9 @@ class Trainer:
             self.schedule,
             # lets the explicit ZeRO-2/3 core rebuild the optimizer with a
             # shard-aware grad-clip norm (same opt-state structure)
-            tx_factory=lambda norm_fn: make_optimizer(opt, self.schedule, norm_fn),
+            tx_factory=lambda norm_fn, zc=None: make_optimizer(
+                opt, self.schedule, norm_fn, zero_collectives=zc
+            ),
             pp_schedule=cfg.mesh.pp_schedule,
         )
         self.eval_step = make_eval_step(self.model, self.mesh, self.plan)
