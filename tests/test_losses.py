@@ -5,10 +5,54 @@ import numpy as np
 import pytest
 
 from zero_transformer_tpu.ops.losses import (
+    chunked_next_token_loss,
     cross_entropy_loss,
     next_token_loss,
     token_log_likelihood,
 )
+
+
+@pytest.mark.parametrize("chunk", [3, 7, 15, 64])
+@pytest.mark.parametrize("ignore", [None, -1])
+def test_chunked_loss_matches_full(chunk, ignore):
+    """chunked_next_token_loss == next_token_loss(h @ w, ...) in value AND
+    gradients (wrt hidden and the projection), across chunk sizes that do
+    and don't divide T-1 (the pad path) and with/without ignored labels."""
+    rng = np.random.default_rng(0)
+    B, T, D, V = 2, 16, 8, 32
+    h = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, V)) * 0.2, jnp.float32)
+    tokens = np.asarray(rng.integers(0, V, (B, T)), np.int32)
+    if ignore is not None:
+        tokens[:, 5] = ignore  # ignored labels scattered mid-sequence
+        tokens[0, 9] = ignore
+    tokens = jnp.asarray(tokens)
+
+    def full(h, w):
+        return next_token_loss(h @ w, tokens, ignore_index=ignore)
+
+    def chunked(h, w):
+        return chunked_next_token_loss(
+            h, w, tokens, chunk, ignore_index=ignore
+        )
+
+    lf, (gh_f, gw_f) = jax.value_and_grad(full, argnums=(0, 1))(h, w)
+    lc, (gh_c, gw_c) = jax.value_and_grad(chunked, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gh_c), np.asarray(gh_f), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw_c), np.asarray(gw_f), atol=1e-6)
+
+
+def test_chunked_loss_z_loss_and_bf16():
+    rng = np.random.default_rng(3)
+    B, T, D, V = 2, 9, 8, 16
+    h = jnp.asarray(rng.normal(size=(B, T, D)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(D, V)) * 0.2, jnp.bfloat16)
+    tokens = jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32)
+    full = next_token_loss((h @ w), tokens, z_loss=1e-3)
+    chunkd = chunked_next_token_loss(h, w, tokens, 4, z_loss=1e-3)
+    assert chunkd.dtype == jnp.float32
+    np.testing.assert_allclose(float(chunkd), float(full), rtol=1e-5)
 
 
 def test_output_is_f32_even_for_bf16_logits():

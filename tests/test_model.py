@@ -43,6 +43,58 @@ def test_internal_loss_matches_external():
     np.testing.assert_allclose(loss, external, rtol=1e-6)
 
 
+@pytest.mark.parametrize("tie", [True, False])
+@pytest.mark.parametrize("packed", [False, True])
+def test_loss_chunk_matches_full_logits(tie, packed):
+    """cfg.loss_chunk computes the identical loss AND parameter gradients
+    without materializing the [B, T, vocab] logits — tied (embedding.T
+    projection) and untied (LMHead kernel), with packed-document boundary
+    masking threaded through. The param TREE is also identical, so the
+    toggle never invalidates a checkpoint."""
+    base = dataclasses.replace(
+        TEST_CFG, tie_embeddings=tie,
+        doc_sep_token=0 if packed else None,
+    )
+    chunked = dataclasses.replace(base, loss_chunk=5)  # 15 positions: pad path
+    x = np.asarray(
+        np.random.default_rng(0).integers(1, base.vocab_size, (2, 16)), np.int32
+    )
+    if packed:
+        x[:, 7] = 0  # separators mid-row
+        x[1, 11] = 0
+    x = jnp.asarray(x)
+    model_f = Transformer(base)
+    params = model_f.init(jax.random.PRNGKey(0), x)
+    model_c = Transformer(chunked)
+    assert (
+        jax.tree.structure(model_c.init(jax.random.PRNGKey(0), x))
+        == jax.tree.structure(params)
+    )
+
+    def loss_of(model, p):
+        out = model.apply(p, x, labels=x, train=True,
+                          rngs={"dropout": jax.random.PRNGKey(1)})
+        return out[1]
+
+    lf, gf = jax.value_and_grad(lambda p: loss_of(model_f, p))(params)
+    lc, gc = jax.value_and_grad(lambda p: loss_of(model_c, p))(params)
+    np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(gf)[0],
+        jax.tree_util.tree_flatten_with_path(gc)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6, err_msg=str(pa)
+        )
+    # the chunked loss-bearing call returns no logits...
+    logits_c, _ = Transformer(chunked).apply(params, x, labels=x)
+    assert logits_c is None
+    # ...but the labels-free call still produces them (eval scoring)
+    logits = Transformer(chunked).apply(params, x)
+    assert logits.shape == (2, 16, base.vocab_size)
+
+
 @pytest.mark.parametrize("position", ["alibi", "rope", "learned"])
 def test_position_variants_forward(position):
     cfg = dataclasses.replace(TEST_CFG, position=position)

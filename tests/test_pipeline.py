@@ -128,6 +128,22 @@ def test_pp_rejects_zero3_and_indivisible(devices):
         make_train_step(model, tx, mesh_tp, plan_tp, 1)
 
 
+def test_pp_loss_chunk_matches_dp(devices):
+    """Chunked CE through the pipeline engine: the last rank computes its
+    loss tile-by-tile (no [b, T, vocab] logits) and the trajectory still
+    matches the fused DP step running the same chunked loss."""
+    cfg = dataclasses.replace(CFG, loss_chunk=5)
+    mesh_pp, s_pp, step_pp = _setup(MeshConfig(pipe=2, data=4), model_cfg=cfg)
+    mesh_dp, s_dp, step_dp = _setup(MeshConfig(), model_cfg=cfg)
+    rng = jax.random.PRNGKey(7)
+    for i in range(2):
+        s_pp, mp = step_pp(s_pp, _batch(i), rng)
+        s_dp, md = step_dp(s_dp, _batch(i), rng)
+    np.testing.assert_allclose(float(mp["loss"]), float(md["loss"]), rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(s_pp.params), jax.tree.leaves(s_dp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
 def test_pp_adafactor_zero2_rejected(devices):
     """Adafactor (factored stats) is ZeRO-axis-aware but not pipe-aware:
     pipe x stage>=2 must reject with the reason, not die in an internal

@@ -319,7 +319,8 @@ def test_tp_activation_sharding_hlo(devices):
 
 
 @pytest.mark.parametrize("stage", [2, 3])
-def test_adafactor_zero2_matches_zero1(devices, stage):
+@pytest.mark.parametrize("dm", [64, 128])
+def test_adafactor_zero2_matches_zero1(devices, stage, dm):
     """Adafactor x explicit ZeRO-2/3 (round-4 VERDICT weak #6: rejected
     outright before round 5). The shard-aware factored-rms/param-scale
     transforms must follow the SAME trajectory as plain optax.adafactor on
@@ -328,8 +329,11 @@ def test_adafactor_zero2_matches_zero1(devices, stage):
     the >=128x128 factoring rule actually fires (wte [256,128] reduces
     across AND along the scatter dim; stacked norm scales [2,128] exercise
     the non-factored sharded fallback). Stage 3 adds FSDP param storage —
-    the 1.3B-on-a-pod configuration the north star names."""
-    cfg = dataclasses.replace(CFG, d_model=128)
+    the 1.3B-on-a-pod configuration the north star names. d_model=64: NO
+    param factors, so opt_state_sharding ZeRO-scatters the whole
+    param-shaped FactoredState.v tree — the elementwise update must run
+    straight on the shards (r5 review finding: this layout crashed)."""
+    cfg = dataclasses.replace(CFG, d_model=dm)
     opt_af = dataclasses.replace(OPT, optimizer="adafactor")
 
     def setup(stage):
@@ -367,6 +371,24 @@ def test_adafactor_zero2_matches_zero1(devices, stage):
     # downgrade the collective schedule)
     ops = _collective_lines(step2, s2, _batch(seed=9), jax.random.PRNGKey(0))
     assert ops["reduce-scatter"], "no reduce-scatter in adafactor ZeRO-2 HLO"
+
+
+def test_loss_chunk_never_materializes_full_logits(devices):
+    """cfg.loss_chunk's whole point, asserted in the compiled per-device
+    HLO: the full [B_local, T, vocab] (or shifted T-1) f32 logits buffer
+    must not exist anywhere in the step — only [B_local, chunk, vocab]
+    tiles — in BOTH step builders (GSPMD stage 1 and the explicit stage-2
+    core). vocab=1024 keeps the shape distinctive vs activations."""
+    cfg = dataclasses.replace(CFG, vocab_size=1024, loss_chunk=8)
+    for stage in (1, 2):
+        mesh, model, plan, state, step = _setup(zero_stage=stage, model_cfg=cfg)
+        txt = step.lower(state, _batch(T=24), jax.random.PRNGKey(0)).compile().as_text()
+        # batch 8 over data=8 -> B_local 1
+        assert "f32[1,8,1024]" in txt, f"stage {stage}: no chunked logits tile"
+        for full in ("f32[1,24,1024]", "f32[1,23,1024]"):
+            assert full not in txt, (
+                f"stage {stage}: full logits {full} materialized despite loss_chunk"
+            )
 
 
 def test_no_involuntary_rematerialization(devices, capfd):

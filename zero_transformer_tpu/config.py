@@ -90,6 +90,14 @@ class ModelConfig:
     # dispatch; expert weights shard over the mesh's `expert` axis (EP).
     n_experts: int = 0
     moe_top_k: int = 2
+    # Chunked cross entropy: compute the LM loss `loss_chunk` sequence
+    # positions at a time so the [B, T, vocab] logits — the step's single
+    # largest activation at real scale (1.6 GB f32 for 1.3B/50k-vocab at
+    # 8x1024 tokens, paid again in backward) — are never materialized; the
+    # loss-bearing forward then returns (None, loss). None = full logits
+    # (needed whenever the caller wants logits, e.g. eval scoring; labels-
+    # free calls always produce logits regardless).
+    loss_chunk: Optional[int] = None
     # per-expert buffer = capacity_factor * top_k * tokens / n_experts
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01  # load-balance aux loss weight
@@ -153,6 +161,8 @@ class ModelConfig:
                 f"[0, {self.vocab_size}): the separator could never appear, "
                 "silently disabling document masking"
             )
+        if self.loss_chunk is not None and self.loss_chunk <= 0:
+            raise ValueError("loss_chunk must be a positive chunk size or None")
         if self.n_experts < 0:
             raise ValueError("n_experts must be >= 0")
         if self.n_experts > 0 and self.moe_top_k not in (1, 2):

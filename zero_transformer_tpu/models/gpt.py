@@ -33,7 +33,7 @@ from zero_transformer_tpu.parallel.sharding import (
     replicate_activation,
 )
 from zero_transformer_tpu.ops.attention import dot_product_attention, xla_attention
-from zero_transformer_tpu.ops.losses import next_token_loss
+from zero_transformer_tpu.ops.losses import chunked_next_token_loss, next_token_loss
 from zero_transformer_tpu.ops.positions import apply_rope
 
 Dtype = Any
@@ -92,6 +92,31 @@ def _norm(cfg: ModelConfig, dtype, name: str):
     if cfg.norm == "rmsnorm":
         return nn.RMSNorm(**kwargs)
     return nn.LayerNorm(use_bias=False, **kwargs)
+
+
+class LMHead(nn.Module):
+    """Untied output projection: a bias-free Dense whose kernel is ALSO
+    directly readable (``head.kernel`` — the chunked-loss path projects the
+    hidden states tile-by-tile and must not call the full-width matmul).
+    Same param path (``lm_head/kernel``), shape, init, and dtype semantics
+    as the ``nn.Dense`` it replaces, so existing checkpoints load
+    unchanged."""
+
+    d_in: int
+    features: int
+    dtype: Dtype
+    param_dtype: Dtype
+
+    def setup(self):
+        self.kernel = self.param(
+            "kernel",
+            nn.with_partitioning(initializers.normal(stddev=0.02), ("embed", "vocab")),
+            (self.d_in, self.features),
+            self.param_dtype,
+        )
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return x.astype(self.dtype) @ jnp.asarray(self.kernel, self.dtype)
 
 
 class Attention(nn.Module):
@@ -392,12 +417,33 @@ class Transformer(nn.Module):
 
         h = _norm(cfg, h.dtype, "ln_f")(h)
 
-        if cfg.tie_embeddings:
-            logits = embed.attend(h)
-        else:
-            logits = _dense(
-                cfg.vocab_size, ("embed", "vocab"), 0.02, dtype, param_dtype, "lm_head"
-            )(h)
+        head = (
+            None
+            if cfg.tie_embeddings
+            else LMHead(cfg.d_model, cfg.vocab_size, dtype, param_dtype, name="lm_head")
+        )
+
+        if labels is not None and cfg.loss_chunk and not self.decode:
+            # chunked CE: the [B, T, vocab] logits never materialize —
+            # the loss-bearing return is (None, loss); labels-free calls
+            # below still produce full logits (eval scoring needs them)
+            ignore = None
+            if packed:
+                labels = mask_boundary_labels(labels, doc_ids)
+                ignore = -1
+            w_dv = (
+                jnp.asarray(embed.embedding, dtype).T
+                if cfg.tie_embeddings
+                else jnp.asarray(head.kernel, dtype)
+            )
+            loss = chunked_next_token_loss(
+                h, w_dv, labels, cfg.loss_chunk, ignore_index=ignore
+            )
+            if train and cfg.n_experts > 0:
+                loss = loss + aux
+            return None, loss
+
+        logits = embed.attend(h) if cfg.tie_embeddings else head(h)
 
         if labels is None:
             return logits

@@ -34,7 +34,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zero_transformer_tpu.config import resolve_dtype
-from zero_transformer_tpu.ops.losses import next_token_loss
+from zero_transformer_tpu.ops.losses import chunked_next_token_loss, next_token_loss
 from zero_transformer_tpu.parallel.mesh import PIPE_AXIS
 from zero_transformer_tpu.parallel.sharding import restrict_spec
 
@@ -223,17 +223,30 @@ def make_pp_train_step(
             {"params": p["blocks"]}, carry_in, rngs={"dropout": mrng}
         )
         h_norm = norm_mod.apply({"params": p["ln_f"]}, h_out)
-        if cfg.tie_embeddings:
-            logits = embed_mod.apply({"params": p["wte"]}, h_norm, method="attend")
-        else:
-            logits = head_mod.apply({"params": p["lm_head"]}, h_norm)
+        labels = tokens
+        ignore = None
         if packed:
             labels = mask_boundary_labels(
                 tokens, doc_ids_from_tokens(tokens, cfg.doc_sep_token)
             )
-            loss = next_token_loss(logits, labels, ignore_index=-1)
+            ignore = -1
+        if cfg.loss_chunk:
+            # same chunked-CE path as the fused model: the [b, T, vocab]
+            # logits tile never materializes on the last rank either
+            w_dv = (
+                jnp.asarray(p["wte"]["embedding"], dtype).T
+                if cfg.tie_embeddings
+                else jnp.asarray(p["lm_head"]["kernel"], dtype)
+            )
+            loss = chunked_next_token_loss(
+                h_norm, w_dv, labels, cfg.loss_chunk, ignore_index=ignore
+            )
         else:
-            loss = next_token_loss(logits, tokens)
+            if cfg.tie_embeddings:
+                logits = embed_mod.apply({"params": p["wte"]}, h_norm, method="attend")
+            else:
+                logits = head_mod.apply({"params": p["lm_head"]}, h_norm)
+            loss = next_token_loss(logits, labels, ignore_index=ignore)
         return h_out, (loss, aux)
 
     def core(params, batch, rng, reduce=True):

@@ -231,10 +231,23 @@ def _sharded_factored_rms(
                     * shard_slice(jnp.expand_dims(col_factor, d1), sdim, g.shape[sdim] if sdim >= 0 else -1)
                 )
                 return u, new_v_row, new_v_col, v
-            if sdim >= 0:  # non-factored sharded leaf: small (norm-scale sized)
-                gsq = jax.lax.all_gather(gsq, zc.axis, axis=sdim, tiled=True)
+            # Non-factored leaf. The v STORAGE layout follows
+            # opt_state_sharding's structural matching, all-or-nothing per
+            # state tree: when NO param in the tree factors, FactoredState.v
+            # is exactly param-shaped, matches the param treedef+shapes, and
+            # is ZeRO-SCATTERED like the params — the elementwise update
+            # then runs straight on the shards, no collective at all. When
+            # >=1 param factors, the (1,)-marker leaves break the match and
+            # the whole v tree is REPLICATED full-size — update the full
+            # buffer from an all-gathered g^2 (these leaves are norm-scale
+            # sized). v.shape distinguishes the two (shard != full whenever
+            # the leaf is actually scattered).
+            if sdim < 0 or v.shape == g.shape:  # full-vs-full or shard-vs-shard
+                new_v = (decay_t * v + (1.0 - decay_t) * gsq).astype(dtype)
+                return g * new_v ** -0.5, v_row, v_col, new_v
+            gsq = jax.lax.all_gather(gsq, zc.axis, axis=sdim, tiled=True)
             new_v = (decay_t * v + (1.0 - decay_t) * gsq).astype(dtype)
-            u = g * shard_slice(new_v, sdim, g.shape[sdim] if sdim >= 0 else -1) ** -0.5
+            u = g * shard_slice(new_v, sdim, g.shape[sdim]) ** -0.5
             return u, v_row, v_col, new_v
 
         out = jax.tree.map(
