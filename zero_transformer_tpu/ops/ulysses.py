@@ -43,6 +43,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from zero_transformer_tpu.ops.attention import xla_attention
 from zero_transformer_tpu.ops.positions import alibi_slopes
 from zero_transformer_tpu.ops.ring_attention import (
+    _axis_rank,
+    _engine_ctx,
+    _explicit_vjp_engine,
     _flash_local_ok,
     _specs,
     _validate_cp_shapes,
@@ -72,9 +75,9 @@ def _ulysses_body(
     H_loc = q.shape[2]
     slopes = None
     if alibi:
-        h_off = jax.lax.axis_index(SEQUENCE_AXIS) * H_loc
+        h_off = _axis_rank(SEQUENCE_AXIS, n) * H_loc
         if tp > 1:
-            h_off = h_off + jax.lax.axis_index(TENSOR_AXIS) * H_tp
+            h_off = h_off + _axis_rank(TENSOR_AXIS, tp) * H_tp
         slopes = jax.lax.dynamic_slice_in_dim(alibi_slopes(H), h_off, H_loc)
         slopes = slopes.reshape(H_loc, 1)
 
@@ -144,6 +147,10 @@ def ulysses_attention(
     scale = float(softmax_scale if softmax_scale is not None else 1.0 / (D**0.5))
     qkv_spec, _ = _specs(mesh, B, tp)
     ids_spec = P(qkv_spec[0], SEQUENCE_AXIS)
+    # nested-context resolution (see ring_attention._engine_ctx): inside the
+    # explicit ZeRO core the data/fsdp axes are already manual — drop them
+    # from the specs and manualize only sequence(+tensor)
+    mesh_arg, axes, (qkv_spec, ids_spec) = _engine_ctx(mesh, (qkv_spec, ids_spec))
     docs = doc_ids is not None
 
     # the local flash call sees the FULL sequence length T
@@ -153,15 +160,17 @@ def ulysses_attention(
             f"flash ulysses attention unsupported for T={T}, D={D}, dtype={q.dtype}"
         )
 
-    body = functools.partial(
-        _ulysses_body, n=n, tp=tp, H=H, causal=causal, alibi=alibi, docs=docs,
-        scale=scale, flash=use_flash, interpret=interpret,
-    )
     ids = (
         doc_ids.astype(jnp.float32) if docs
         else jnp.zeros((B, T), jnp.float32)
     )
-    return shard_map(
-        body, mesh=mesh, in_specs=(qkv_spec,) * 3 + (ids_spec,),
-        out_specs=qkv_spec, check_vma=False,
-    )(q, k, v, ids)
+    # explicit recompute vjp shared with the XLA-fallback ring: jax's
+    # transpose of a nested partial-manual shard_map mis-lowers, so the
+    # backward re-differentiates the body inside a fresh shard_map
+    body = functools.partial(
+        _ulysses_body, n=n, tp=tp, H=H, causal=causal, alibi=alibi, docs=docs,
+        scale=scale, flash=use_flash, interpret=interpret,
+    )
+    return _explicit_vjp_engine(
+        body, mesh_arg, qkv_spec, ids_spec, axes, q, k, v, ids
+    )

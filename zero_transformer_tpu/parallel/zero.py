@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -159,9 +160,11 @@ def make_train_step(
     ``tx_factory(global_norm_fn)`` rebuilds the optimizer with a shard-aware
     grad-clip norm for that core (see ``make_optimizer``); without it the
     core pre-clips using the provided ``tx`` (see
-    ``_make_explicit_zero_step``). With the sequence (ring-attention CP) axis
-    active the GSPMD constraint-hint path below is used instead — the ring
-    engine is itself a shard_map and does not nest under a manual ZeRO core.
+    ``_make_explicit_zero_step``). The sequence (context-parallel) axis
+    composes: the ring/Ulysses engines nest their shard_maps inside the
+    partial-manual core (``ops.ring_attention._engine_ctx`` — before round
+    5 these meshes fell back to the GSPMD hint path, which compiled ZeRO-2
+    to stage-1 traffic: zero reduce-scatters, weight-sized all-reduces).
     An active ``pipe`` axis routes to the GPipe wavefront step
     (``parallel.pipeline``).
     """
@@ -174,7 +177,19 @@ def make_train_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory,
             pp_schedule=pp_schedule,
         )
-    if zero_stage >= 2 and mesh.shape[SEQUENCE_AXIS] == 1:
+    from zero_transformer_tpu.parallel.mesh import TENSOR_AXIS
+
+    # sequence x tensor x explicit-core: XLA's SPMD partitioner CHECK-fails
+    # (spmd_partitioner_util.cc:495 — the same upstream crash class as
+    # pipe x tensor) partitioning the auto tensor axis around the nested CP
+    # engine; those meshes keep the GSPMD constraint-hint path below.
+    # ZTPU_SEQ_TENSOR_EXPLICIT_PROBE=1 re-probes on future jax upgrades
+    # (subprocess only: the failure is a CHECK abort, not an exception).
+    seq_tensor = (
+        mesh.shape[SEQUENCE_AXIS] > 1 and mesh.shape[TENSOR_AXIS] > 1
+        and os.environ.get("ZTPU_SEQ_TENSOR_EXPLICIT_PROBE") != "1"
+    )
+    if zero_stage >= 2 and not seq_tensor:
         return _make_explicit_zero_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory
         )
