@@ -536,6 +536,11 @@ def main() -> None:
          upside_timeout),
         ("remat_dots", {"BENCH_REMAT": "1", "BENCH_REMAT_POLICY": "dots"}, upside_timeout),
         ("remat_off", {"BENCH_REMAT": "0", "BENCH_BATCH": "4", "BENCH_ACCUM": "16"}, upside_timeout),
+        # long-context training point: 580M at 8k tokens/row (the regime the
+        # Pallas flash kernel + chunked CE exist for; same 64k tokens/step)
+        ("long_ctx_8k",
+         {"BENCH_REMAT": "1", "BENCH_SEQ": "8192", "BENCH_BATCH": "1",
+          "BENCH_ACCUM": "8", "BENCH_LOSS_CHUNK": "1024"}, upside_timeout),
     ):
         if os.environ.get("BENCH_SIMULATE_HUNG") == "1":
             res = {"ok": False, "error": "simulated: backend init hung",

@@ -231,6 +231,12 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=None,
                     help="override training.total_steps (full mode: right-size "
                          "the on-chip run to the available window)")
+    ap.add_argument("--model", default=None,
+                    help="full mode: zoo name overriding byte_25m for BOTH "
+                         "train and eval (byte_2m = the CPU-scale sibling)")
+    ap.add_argument("--extra-set", nargs="*", default=[], metavar="KEY=V",
+                    help="extra train.py --set overrides appended LAST "
+                         "(e.g. training.batch_size=4 for a CPU budget)")
     args = ap.parse_args()
 
     out = Path(args.out)
@@ -297,6 +303,15 @@ def main() -> None:
             "--set", f"optimizer.warmup_steps={max(1, min(200, args.steps // 10))}",
             "--set", f"training.evaluation_frequency={max(10, args.steps // 10)}",
         ]
+    if args.model:
+        if smoke:
+            raise SystemExit(
+                "--model is a full-mode option (smoke always runs the 'test' "
+                "zoo model); drop --mode smoke or drop --model"
+            )
+        overrides += ["--set", f"model.size={args.model}"]
+    for kv in args.extra_set:
+        overrides += ["--set", kv]
     env = dict(os.environ)
     code = (
         "import jax\n"
@@ -315,7 +330,7 @@ def main() -> None:
             force_cpu=True, cwd=REPO)
 
     # --- eval: byte ppl, bits-per-byte, last-word accuracy
-    model_name = "test" if smoke else "byte_25m"
+    model_name = "test" if smoke else (args.model or "byte_25m")
     force_cpu = smoke or args.force_cpu
     results = {}
     eval_common = ["--model", model_name, "--params", params,

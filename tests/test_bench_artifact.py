@@ -84,6 +84,45 @@ def test_sanitize_recurses_and_line_parses():
     assert json.loads(line)["n"] == 3
 
 
+def _load_watch():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch", REPO / "scripts" / "tpu_watch.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tpu_watch_live_detection_and_promotion(tmp_path, monkeypatch):
+    """The watcher promotes ONLY artifacts with a genuinely-live TPU
+    scenario — cached replays and CPU fallbacks must never overwrite
+    BENCH_measured.json (that file is the cached-fallback SOURCE; recycling
+    a stale value into it would degrade provenance every wedged round)."""
+    watch = _load_watch()
+    live = {"metric": "train_tokens_per_sec_per_chip_580m", "value": 30000.0,
+            "unit": "tokens/s/chip",
+            "extra": {"scenarios": {"remat_on": {"ok": True, "platform": "tpu"}}}}
+    assert watch.is_live_tpu(live)
+    cached = {"metric": "train_tokens_per_sec_per_chip_580m_cached",
+              "value": 30429.5,
+              "extra": {"scenarios": {"remat_on": {"ok": False,
+                                                   "backend_init_hung": True}}}}
+    assert not watch.is_live_tpu(cached)
+    cpu = {"metric": "train_tokens_per_sec_per_chip_cpu_fallback", "value": 2.0,
+           "extra": {"scenarios": {"remat_on": {"ok": True, "platform": "cpu"}}}}
+    assert not watch.is_live_tpu(cpu)
+
+    monkeypatch.setattr(watch, "ROOT", str(tmp_path))
+    watch.promote(live)
+    promoted = json.loads((tmp_path / "BENCH_measured.json").read_text())
+    assert promoted["value"] == 30000.0
+    assert "measured_at_utc" in promoted
+    # the promoted artifact must satisfy bench.py's own cached-artifact
+    # acceptance rules (the whole point of promotion)
+    art = bench._cached_tpu_artifact(root=str(tmp_path))
+    assert art is not None and art["value"] == 30000.0
+
+
 def test_baselines_table_covers_north_star():
     """The 1.3B north-star scenario must resolve a per-model baseline (a
     falls-through-to-580m default would overstate vs_baseline)."""

@@ -451,3 +451,29 @@ def test_no_involuntary_rematerialization(devices, capfd):
         jax.config.update("jax_enable_compilation_cache", old)
     err = capfd.readouterr().err
     assert "Involuntary full rematerialization" not in err, err[-2000:]
+
+
+def test_apply_tx_factory_signatures():
+    """The tx_factory contract: 1-arg factories (the original form) get only
+    the norm fn; 2-positional-arg factories also receive the
+    ZeroCollectives; keyword-only/**kwargs params don't count (r5 review
+    finding: counting them passed zc positionally into factories that can't
+    bind it)."""
+    from zero_transformer_tpu.parallel.zero import apply_tx_factory
+
+    calls = []
+    apply_tx_factory(lambda norm_fn: calls.append(("one", norm_fn)), "N", "ZC")
+    apply_tx_factory(
+        lambda norm_fn, zc=None: calls.append(("two", norm_fn, zc)), "N", "ZC"
+    )
+    apply_tx_factory(
+        lambda norm_fn, **kw: calls.append(("kw", norm_fn, kw)), "N", "ZC"
+    )
+
+    def kwonly(norm_fn, *, log=False):
+        calls.append(("kwonly", norm_fn, log))
+
+    apply_tx_factory(kwonly, "N", "ZC")
+    assert calls == [
+        ("one", "N"), ("two", "N", "ZC"), ("kw", "N", {}), ("kwonly", "N", False),
+    ]

@@ -37,7 +37,11 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zero_transformer_tpu.parallel import sharding as shd
-from zero_transformer_tpu.parallel.mesh import SEQUENCE_AXIS, zero_axes
+from zero_transformer_tpu.parallel.mesh import (
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+    zero_axes,
+)
 
 
 @flax.struct.dataclass
@@ -177,8 +181,6 @@ def make_train_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory,
             pp_schedule=pp_schedule,
         )
-    from zero_transformer_tpu.parallel.mesh import TENSOR_AXIS
-
     # sequence x tensor x explicit-core: XLA's SPMD partitioner CHECK-fails
     # (spmd_partitioner_util.cc:495 — the same upstream crash class as
     # pipe x tensor) partitioning the auto tensor axis around the nested CP
