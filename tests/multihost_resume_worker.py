@@ -54,6 +54,17 @@ def main():
     assert jax.process_count() == 4, jax.process_count()
     assert jax.device_count() == 8, jax.device_count()
 
+    # Warmup collective FIRST: gloo creates its context lazily at the first
+    # cross-process collective, with a fixed 30s key-value rendezvous
+    # deadline. Reaching that first collective straight after init keeps
+    # inter-process skew at milliseconds; without this, the first collective
+    # is the train step, whose per-process XLA compile can skew processes
+    # past 30s on a loaded box (observed flake). The clique is then cached
+    # for every later collective.
+    from zero_transformer_tpu.utils.pod_check import pod_check
+
+    assert pod_check(timeout=300.0), "pod warmup psum failed"
+
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from zero_transformer_tpu import checkpoint as ckpt_lib
@@ -94,10 +105,25 @@ def main():
         os.environ["WORKER_CKPT_DIR"], keep=2, async_save=False
     )
 
-    def run_steps(it, state, n):
+    # AOT-compile + KV barrier before the FIRST execution of each phase:
+    # per-rank XLA compile of the train step can skew ranks by minutes on a
+    # loaded box, and a rank that starts executing while a peer still
+    # compiles hits gloo's fixed ~30s read timeout mid-collective. The
+    # barrier rides the coordination service (KV store, long timeout), not
+    # gloo, so it absorbs the skew; execution then starts aligned.
+    from jax._src import distributed as _dist
+
+    _client = getattr(_dist.global_state, "client", None)
+
+    def run_steps(it, state, n, tag, barrier=True):
+        compiled = None
         for _ in range(n):
             batch = device_put_batch(next(it), batch_sharding)
-            state, metrics = step(state, batch, rng)
+            if compiled is None:
+                compiled = step.lower(state, batch, rng).compile()
+                if barrier and _client is not None:
+                    _client.wait_at_barrier(f"compiled_{mode}_{tag}", 600_000)
+            state, metrics = compiled(state, batch, rng)
             loss = float(metrics["loss"])
             assert loss == loss, "non-finite loss"
             print(f"LOSS step={int(state.step)} {loss:.10f}", flush=True)
@@ -112,10 +138,10 @@ def main():
         state, meta = mgr.restore(abstract)
         assert int(state.step) == 2, int(state.step)
         loader.restore(meta["loader"])
-        state = run_steps(iter(loader), state, 2)
+        state = run_steps(iter(loader), state, 2, "resume")
     else:  # straight / interrupted
         it = iter(loader)
-        state = run_steps(it, state, 2)
+        state = run_steps(it, state, 2, "warm")
         mgr.save(2, state, meta={"loader": loader.state()}, force=True)
         mgr.wait()
         print("SAVED step=2", flush=True)
@@ -126,13 +152,17 @@ def main():
             # collective cannot complete — the watchdog documents the stall
             threading.Timer(90.0, lambda: os._exit(7)).start()
             try:
-                run_steps(it, state, 1)
+                # NO barrier here: it would wait on the dead victim and the
+                # watchdog would fire before the collective is ever issued —
+                # the property under test is the COLLECTIVE stalling with a
+                # dead member (the clique already exists from steps 1-2)
+                run_steps(it, state, 1, "survivor", barrier=False)
                 print("SURVIVOR_STEP_COMPLETED_UNEXPECTEDLY", flush=True)
             except Exception as e:  # distributed runtime noticed the death
                 print(f"SURVIVOR_ERROR {type(e).__name__}", flush=True)
             os._exit(7)
         else:
-            state = run_steps(it, state, 2)
+            state = run_steps(it, state, 2, "tail")
 
     mgr.close()
     print("WORKER_OK", flush=True)

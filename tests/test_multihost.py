@@ -78,6 +78,37 @@ def _losses(out: str) -> dict:
     }
 
 
+def _phase(worker, n, env, check, attempts=2, clean_ckpt=True):
+    """Run one multi-process phase; ONE retry when the failure is the
+    known infra flake (gloo's fixed 30s context-init deadline trips when
+    per-process compile skew exceeds it on a loaded box — observed with
+    concurrent background training; not a repo bug).
+
+    ``clean_ckpt``: wipe WORKER_CKPT_DIR before each attempt — orbax
+    save(force=True) does NOT overwrite an existing step
+    (StepAlreadyExistsError), so a writer phase's retry must not see
+    attempt 1's step. MUST be False for the resume phase, which exists to
+    READ that directory."""
+    import shutil
+
+    for a in range(attempts):
+        if clean_ckpt and env.get("WORKER_CKPT_DIR"):
+            shutil.rmtree(env["WORKER_CKPT_DIR"], ignore_errors=True)
+        procs = _launch(worker, n, env)
+        outs = _reap(procs, 420)
+        err = check(procs, outs)
+        if err is None:
+            return outs
+        infra = any(
+            "Gloo context initialization failed" in o
+            or "DEADLINE_EXCEEDED" in o
+            for o in outs
+        )
+        if a + 1 < attempts and infra:
+            continue
+        pytest.fail(err)
+
+
 @pytest.mark.slow
 def test_four_process_kill_and_resume(tmp_path):
     """Crash recovery across REAL process boundaries (round-4 VERDICT next
@@ -86,39 +117,50 @@ def test_four_process_kill_and_resume(tmp_path):
     loader position and continues with EXACTLY the trajectory an
     uninterrupted run produces. The reference's only recovery was manual
     (``src/utils/pod_test.py``, ``main_zero.py:291-313``)."""
+
+    def all_ok(procs, outs):
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            # the ground truth must come from a fully-clean run, not a job
+            # where a non-rank-0 worker died while rank 0 limped to step 4
+            if p.returncode != 0 or "WORKER_OK" not in out:
+                return f"worker {i} rc={p.returncode}:\n{out}"
+        return None
+
     env = {"WORKER_CKPT_DIR": str(tmp_path / "straight_ckpt"),
            "WORKER_MODE": "straight"}
-    outs = _reap(procs := _launch(RESUME_WORKER, 4, env), 420)
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        # the ground truth must come from a fully-clean run, not a job
-        # where a non-rank-0 worker died while rank 0 limped to step 4
-        assert p.returncode == 0 and "WORKER_OK" in out, (
-            f"straight worker {i} rc={p.returncode}:\n{out}"
-        )
+    outs = _phase(RESUME_WORKER, 4, env, all_ok)
     truth = _losses(outs[0])
     assert set(truth) == {1, 2, 3, 4}, outs[0]
 
     # phase 2: periodic save at step 2, then process 3's host "dies"
+    def interrupted_ok(procs, outs):
+        if procs[3].returncode != 9:
+            return f"victim survived rc={procs[3].returncode}:\n{outs[3]}"
+        for i in (0, 1, 2):
+            if "SAVED step=2" not in outs[i]:
+                return f"survivor {i} never saved:\n{outs[i]}"
+            # a job with a dead member must NOT complete the next step...
+            if "SURVIVOR_STEP_COMPLETED_UNEXPECTEDLY" in outs[i]:
+                return outs[i]
+            # ...and must exit through the worker's own watchdog/error
+            # path (rc 7), not hang until the harness deadline kills it
+            if procs[i].returncode != 7:
+                return f"survivor {i} rc={procs[i].returncode}:\n{outs[i]}"
+        return None
+
     env = {"WORKER_CKPT_DIR": str(tmp_path / "ckpt"),
            "WORKER_MODE": "interrupted"}
-    procs = _launch(RESUME_WORKER, 4, env)
-    outs = _reap(procs, 420)
-    assert procs[3].returncode == 9, f"victim survived:\n{outs[3]}"
-    for i in (0, 1, 2):
-        assert "SAVED step=2" in outs[i], f"survivor {i} never saved:\n{outs[i]}"
-        # a job with a dead member must NOT complete the next step
-        assert "SURVIVOR_STEP_COMPLETED_UNEXPECTEDLY" not in outs[i], outs[i]
-        # ...and must exit through the worker's own watchdog/error path
-        # (rc 7), not hang until the harness deadline kills it
-        assert procs[i].returncode == 7, (
-            f"survivor {i} rc={procs[i].returncode}:\n{outs[i]}"
-        )
+    _phase(RESUME_WORKER, 4, env, interrupted_ok)
 
     # phase 3: fresh job restores and continues
+    def resume_ok(procs, outs):
+        for i, out in enumerate(outs):
+            if "WORKER_OK" not in out:
+                return f"resume worker {i}:\n{out}"
+        return None
+
     env["WORKER_MODE"] = "resume"
-    outs = _reap(_launch(RESUME_WORKER, 4, env), 420)
-    for i, out in enumerate(outs):
-        assert "WORKER_OK" in out, f"resume worker {i}:\n{out}"
+    outs = _phase(RESUME_WORKER, 4, env, resume_ok, clean_ckpt=False)
     resumed = _losses(outs[0])
     assert set(resumed) == {3, 4}, outs[0]
     # exact continuation: the interruption is invisible in the trajectory
