@@ -189,3 +189,41 @@ def test_flash_ring_requires_supported_shape(devices):
     q, k, v = _qkv(1, 32, 4, 4, 16)  # t_local=4 too small for the kernel
     with pytest.raises(NotImplementedError):
         ring_attention(q, k, v, mesh, impl="flash", interpret=True)
+
+
+def test_engine_ctx_nested_resolution(devices):
+    """_engine_ctx: standalone = full behavior (every mentioned axis manual,
+    specs untouched, concrete mesh); in a context whose abstract mesh marks
+    axes Manual (the inside of the explicit ZeRO core), those axes are
+    dropped from specs/axis set and the ambient ABSTRACT mesh is returned
+    (a concrete all-Auto mesh is rejected there). This is the contract that
+    lets the CP engines nest inside the explicit ZeRO core (r5)."""
+    from jax.sharding import AbstractMesh, AxisType, PartitionSpec as P
+
+    from zero_transformer_tpu.ops.ring_attention import _engine_ctx
+
+    mesh = make_mesh(MeshConfig(data=4, sequence=2))
+    qkv = P(("data",), "sequence", None, None)
+    ids = P(("data",), "sequence")
+
+    # standalone: unchanged
+    mesh_arg, axes, (q2, i2) = _engine_ctx(mesh, (qkv, ids))
+    assert mesh_arg is mesh
+    assert axes == frozenset({"data", "sequence"})
+    assert q2 == qkv and i2 == ids
+
+    # nested: the ambient abstract mesh marks `data` Manual (exactly what
+    # get_abstract_mesh() returns inside the core's partial-manual region)
+    names = mesh.abstract_mesh.axis_names
+    nested = AbstractMesh(
+        tuple(mesh.shape[n] for n in names), names,
+        axis_types=tuple(
+            AxisType.Manual if n == "data" else AxisType.Auto for n in names
+        ),
+    )
+    with jax.sharding.use_abstract_mesh(nested):
+        mesh_arg, axes, (q2, i2) = _engine_ctx(mesh, (qkv, ids))
+    assert mesh_arg is not mesh and mesh_arg.axis_types == nested.axis_types
+    assert axes == frozenset({"sequence"})
+    assert q2 == P(None, "sequence", None, None)
+    assert i2 == P(None, "sequence")
