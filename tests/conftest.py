@@ -26,6 +26,13 @@ jax.config.update("jax_platforms", "cpu")
 # CPU compiles of 8-device programs that are identical run-to-run (round-3
 # VERDICT weak #6). Shared across workers and runs; xdist workers hit the
 # same directory safely (orbax-style atomic renames inside jax's cache).
+# KNOWN ENVIRONMENT FLAKE (r5): on virtualized boxes the host CPU feature
+# set can differ from the one a cached AOT entry was compiled with (XLA
+# warns 'could lead to execution errors such as SIGILL' on every load);
+# occasionally an xdist worker dies mid-test with no Python traceback and
+# the test shows FAILED without a failures section. Rerunning is green.
+# If it recurs persistently, delete the cache dir to repopulate it with
+# current-host features.
 _cache_dir = os.path.expanduser(
     os.environ.get("JAX_TEST_COMPILATION_CACHE", "/tmp/zero_transformer_tpu_jax_cache")
 )
