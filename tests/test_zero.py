@@ -453,6 +453,70 @@ def test_no_involuntary_rematerialization(devices, capfd):
     assert "Involuntary full rematerialization" not in err, err[-2000:]
 
 
+def test_bf16_grad_accum(devices):
+    """grad_accum_dtype="bfloat16" — the knob that fits the 1.3B single-chip
+    north star in 16 GB HBM (an f32 accumulator is one of three param-sized
+    f32 trees the AOT compiler rejected, ``runs/bench_r5_live1.json``) —
+    tracks the f32-accumulator trajectory closely in BOTH step builders,
+    while "float32" stays bit-identical to the default path."""
+    for stage in (1, 2):
+        mesh = make_mesh(MeshConfig())
+        model = Transformer(CFG)
+        tx = make_optimizer(OPT)
+        plan = make_plan(model, tx, mesh, (2, 16), stage)
+
+        def run(**kw):
+            state = init_train_state(
+                model, tx, jax.random.PRNGKey(0), mesh, (2, 16), plan
+            )
+            step = make_train_step(
+                model, tx, mesh, plan, stage, make_schedule(OPT), **kw
+            )
+            rng = jax.random.PRNGKey(5)
+            for i in range(4):
+                state, m = step(state, _batch(accum=4, seed=i), rng)
+            return state, float(m["loss"])
+
+        s_def, l_def = run()
+        s_f32, l_f32 = run(grad_accum_dtype="float32")
+        s_bf, l_bf = run(grad_accum_dtype="bfloat16")
+        # explicit float32 is the default, bit for bit
+        assert l_f32 == l_def, f"stage {stage}"
+        for a, b in zip(jax.tree.leaves(s_f32.params), jax.tree.leaves(s_def.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # bf16 accumulation rounds each micro-add to 8 mantissa bits; the
+        # trajectory stays close but not identical
+        np.testing.assert_allclose(l_bf, l_f32, rtol=5e-3, err_msg=f"stage {stage}")
+        for a, b in zip(jax.tree.leaves(s_bf.params), jax.tree.leaves(s_f32.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-3, err_msg=f"stage {stage}"
+            )
+
+
+def test_grad_accum_dtype_rejections():
+    """Bad dtypes fail loudly; the pipeline engine (accumulation lives in
+    its wavefront carries, not the scan here) rejects bfloat16. Every
+    rejection fires before any step executes, so no state init (an executed
+    jit compile) is needed — build the plan pieces directly."""
+    mesh = make_mesh(MeshConfig())
+    model = Transformer(CFG)
+    tx = make_optimizer(OPT)
+    plan = make_plan(model, tx, mesh, (2, 16), 1)
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        make_train_step(
+            model, tx, mesh, plan, 1, grad_accum_dtype="float16"
+        )
+    from zero_transformer_tpu.config import TrainingConfig
+
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        TrainingConfig(grad_accum_dtype="f32")
+    mesh_pp = make_mesh(MeshConfig(data=4, pipe=2))
+    with pytest.raises(NotImplementedError, match="pipeline"):
+        make_train_step(
+            model, tx, mesh_pp, plan, 1, grad_accum_dtype="bfloat16"
+        )
+
+
 def test_apply_tx_factory_signatures():
     """The tx_factory contract: 1-arg factories (the original form) get only
     the norm fn; 2-positional-arg factories also receive the

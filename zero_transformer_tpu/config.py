@@ -258,6 +258,22 @@ class TrainingConfig:
     # checked at each log sync point, so it costs nothing extra. The
     # reference could burn days of pod time past a divergence.
     halt_on_nan: bool = True
+    # dtype of the gradient-accumulation buffer ("float32" | "bfloat16").
+    # bfloat16 halves the param-sized accumulator — the knob that lets the
+    # 1.3B single-chip config fit 16 GB HBM (three f32 param-sized trees —
+    # master params, accumulator, micro-grads — are 15.6 GB before
+    # activations). Micro-step gradients are still computed in f32; only the
+    # running sum rounds (once per add, upcast-add-round), and adafactor's
+    # per-tensor normalization makes it insensitive to that scale of noise.
+    # float32 is the default and is bit-identical to the pre-knob behavior.
+    grad_accum_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.grad_accum_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "training.grad_accum_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.grad_accum_dtype!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -113,6 +113,26 @@ def init_train_state(
     return jax.jit(_init, out_shardings=plan.state)(rng)
 
 
+def _accum_dtype(name: str):
+    dt = jnp.dtype(name)
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(
+            f"grad_accum_dtype must be float32 or bfloat16, got {name!r}"
+        )
+    return dt
+
+
+def _accum_add(a, g):
+    """Accumulate micro-step gradient ``g`` into buffer ``a``: add in the
+    promoted dtype, round once into the accumulator dtype — a bfloat16
+    accumulator rounds once per micro-step instead of once per operand, and
+    an f32 accumulator is never downcast even when grads are low-precision
+    (``model.param_dtype=bfloat16`` makes grads bf16; ``jnp.add`` promoted
+    them before this helper existed and so does this)."""
+    ct = jnp.promote_types(a.dtype, g.dtype)
+    return (a.astype(ct) + g.astype(ct)).astype(a.dtype)
+
+
 def _with_ambient_mesh(jitted, mesh: Mesh):
     """Run calls AND lowering of a jitted step under ``jax.set_mesh(mesh)``.
 
@@ -144,6 +164,7 @@ def make_train_step(
     schedule: Optional[Callable] = None,
     tx_factory: Optional[Callable] = None,
     pp_schedule: str = "gpipe",
+    grad_accum_dtype: str = "float32",
 ) -> Callable:
     """Build the fused jitted train step.
 
@@ -174,7 +195,14 @@ def make_train_step(
     """
     from zero_transformer_tpu.parallel.mesh import PIPE_AXIS
 
+    acc_dt = _accum_dtype(grad_accum_dtype)
     if mesh.shape[PIPE_AXIS] > 1:
+        if acc_dt != jnp.float32:
+            raise NotImplementedError(
+                "grad_accum_dtype=bfloat16 is not plumbed through the pipeline "
+                "engine (its accumulation lives in the wavefront carries); use "
+                "float32 with pipe > 1"
+            )
         from zero_transformer_tpu.parallel.pipeline import make_pp_train_step
 
         return make_pp_train_step(
@@ -193,7 +221,8 @@ def make_train_step(
     )
     if zero_stage >= 2 and not seq_tensor:
         return _make_explicit_zero_step(
-            model, tx, mesh, plan, zero_stage, schedule, tx_factory
+            model, tx, mesh, plan, zero_stage, schedule, tx_factory,
+            grad_accum_dtype=grad_accum_dtype,
         )
 
     def loss_fn(params, micro, rng):
@@ -226,11 +255,11 @@ def make_train_step(
             def body(carry, i):
                 loss_sum, grads_sum = carry
                 loss, grads = micro_grads(i)
-                grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
+                grads_sum = jax.tree.map(_accum_add, grads_sum, grads)
                 return (loss_sum + loss, grads_sum), None
 
             zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+                lambda p: jnp.zeros(p.shape, acc_dt), state.params
             )
             if zero_stage >= 2:
                 zero_grads = constrain_zero(zero_grads)
@@ -238,7 +267,9 @@ def make_train_step(
                 body, (jnp.zeros((), jnp.float32), zero_grads), jnp.arange(accum)
             )
             loss = loss / accum
-            grads = jax.tree.map(lambda g: g / accum, grads)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32) / accum, grads
+            )
 
         grad_norm = optax.global_norm(grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -377,6 +408,7 @@ def _make_explicit_zero_step(
     zero_stage: int,
     schedule: Optional[Callable],
     tx_factory: Optional[Callable],
+    grad_accum_dtype: str = "float32",
 ) -> Callable:
     """ZeRO-2/3 train step with hand-placed collectives under shard_map.
 
@@ -398,6 +430,7 @@ def _make_explicit_zero_step(
     """
     zc = ZeroCollectives(mesh, plan)
     zaxes, axis = zc.zaxes, zc.axis
+    acc_dt = _accum_dtype(grad_accum_dtype)
 
     tx_inner = (
         apply_tx_factory(tx_factory, zc.shard_norm, zc)
@@ -438,16 +471,18 @@ def _make_explicit_zero_step(
             def body(carry, i):
                 loss_sum, grads_sum = carry
                 loss, grads = micro(i)
-                return (loss_sum + loss, jax.tree.map(jnp.add, grads_sum, grads)), None
+                return (loss_sum + loss, jax.tree.map(_accum_add, grads_sum, grads)), None
 
             zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), param_shards
+                lambda p: jnp.zeros(p.shape, acc_dt), param_shards
             )
             (loss, grads), _ = jax.lax.scan(
                 body, (jnp.zeros((), jnp.float32), zero_grads), jnp.arange(accum)
             )
             loss = loss / accum
-            grads = jax.tree.map(lambda g: g / accum, grads)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32) / accum, grads
+            )
 
         grad_norm = zc.shard_norm(grads)
         updates, new_opt = tx_inner.update(grads, state.opt_state, param_shards)

@@ -77,6 +77,7 @@ class Trainer:
                 opt, self.schedule, norm_fn, zero_collectives=zc
             ),
             pp_schedule=cfg.mesh.pp_schedule,
+            grad_accum_dtype=cfg.training.grad_accum_dtype,
         )
         self.eval_step = make_eval_step(self.model, self.mesh, self.plan)
         self.batch_sharding = NamedSharding(
