@@ -30,14 +30,15 @@ CFG = ModelConfig(
 OPT = OptimizerConfig(peak_learning_rate=1e-3, warmup_steps=4, total_steps=64)
 
 
-def _setup(mesh_cfg, model_cfg=CFG, zero_stage=1):
+def _setup(mesh_cfg, model_cfg=CFG, zero_stage=1, grad_accum_dtype="float32"):
     mesh = make_mesh(mesh_cfg)
     model = Transformer(model_cfg)
     tx = make_optimizer(OPT)
     plan = make_plan(model, tx, mesh, (2, 16), zero_stage)
     state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, (2, 16), plan)
     step = make_train_step(model, tx, mesh, plan, zero_stage, make_schedule(OPT),
-                           pp_schedule=mesh_cfg.pp_schedule)
+                           pp_schedule=mesh_cfg.pp_schedule,
+                           grad_accum_dtype=grad_accum_dtype)
     return mesh, state, step
 
 
@@ -290,3 +291,21 @@ def test_pp_1f1b_zero2_matches_dp_trajectory(devices):
 
     txt = step_pp.lower(s_pp, _batch(9), rng).compile().as_text()
     assert "reduce-scatter" in txt, "no literal reduce-scatter in 1F1B ZeRO-2 HLO"
+
+
+def test_pp_1f1b_bf16_accum_matches_f32(devices):
+    """grad_accum_dtype=bfloat16 composes with 1F1B (the knob's target
+    regime: O(P) stash AND a half-size accumulator carry — the 16 GB
+    large-model recipe, see ``zero.py::_accum_add``): trajectory tracks the
+    f32-accumulator 1F1B run closely. GPipe's rejection is covered in
+    ``test_zero.py::test_grad_accum_dtype_rejections``."""
+    pp = MeshConfig(pipe=2, data=4, pp_schedule="1f1b")
+    _, s32, step32 = _setup(pp, grad_accum_dtype="float32")
+    _, sbf, stepbf = _setup(pp, grad_accum_dtype="bfloat16")
+    rng = jax.random.PRNGKey(7)
+    for i in range(3):
+        s32, m32 = step32(s32, _batch(i), rng)
+        sbf, mbf = stepbf(sbf, _batch(i), rng)
+    np.testing.assert_allclose(float(mbf["loss"]), float(m32["loss"]), rtol=5e-3)
+    for a, b in zip(jax.tree.leaves(sbf.params), jax.tree.leaves(s32.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)

@@ -494,8 +494,9 @@ def test_bf16_grad_accum(devices):
 
 
 def test_grad_accum_dtype_rejections():
-    """Bad dtypes fail loudly; the pipeline engine (accumulation lives in
-    its wavefront carries, not the scan here) rejects bfloat16. Every
+    """Bad dtypes fail loudly; the GPipe schedule (accumulation lives inside
+    scan-VJP, not a retargetable carry) rejects bfloat16 — 1F1B accepts it
+    (``test_pipeline.py::test_pp_1f1b_bf16_accum_matches_f32``). Every
     rejection fires before any step executes, so no state init (an executed
     jit compile) is needed — build the plan pieces directly."""
     mesh = make_mesh(MeshConfig())
@@ -511,7 +512,7 @@ def test_grad_accum_dtype_rejections():
     with pytest.raises(ValueError, match="grad_accum_dtype"):
         TrainingConfig(grad_accum_dtype="f32")
     mesh_pp = make_mesh(MeshConfig(data=4, pipe=2))
-    with pytest.raises(NotImplementedError, match="pipeline"):
+    with pytest.raises(NotImplementedError, match="1f1b"):
         make_train_step(
             model, tx, mesh_pp, plan, 1, grad_accum_dtype="bfloat16"
         )

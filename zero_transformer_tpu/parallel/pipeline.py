@@ -85,6 +85,7 @@ def make_pp_train_step(
     schedule: Optional[Callable] = None,
     tx_factory: Optional[Callable] = None,
     pp_schedule: str = "gpipe",
+    grad_accum_dtype: str = "float32",
 ) -> Callable:
     """Fused train step for meshes with an active ``pipe`` axis.
 
@@ -124,7 +125,7 @@ def make_pp_train_step(
         mask_boundary_labels,
     )
     from zero_transformer_tpu.parallel.mesh import TENSOR_AXIS
-    from zero_transformer_tpu.parallel.zero import TrainState
+    from zero_transformer_tpu.parallel.zero import TrainState, _accum_add, _accum_dtype
 
     cfg = model.cfg
     n_stages = mesh.shape[PIPE_AXIS]
@@ -134,6 +135,14 @@ def make_pp_train_step(
         # build the gpipe schedule while the user expects 1F1B's O(P) memory
         raise ValueError(
             f"pp_schedule must be 'gpipe' or '1f1b', got {pp_schedule!r}"
+        )
+    acc_dt = _accum_dtype(grad_accum_dtype)
+    if acc_dt != jnp.float32 and pp_schedule != "1f1b":
+        raise NotImplementedError(
+            "grad_accum_dtype=bfloat16 requires pp_schedule='1f1b' (its "
+            "gradient accumulator is a hand-placed scan carry; GPipe's lives "
+            "inside jax's scan-VJP machinery, which follows the param dtype) "
+            "— and 1F1B is the memory-starved regime the knob exists for"
         )
     if zero_stage >= 3:
         raise NotImplementedError(
@@ -354,7 +363,7 @@ def make_pp_train_step(
             gaux = jnp.asarray(1.0 if cfg.n_experts > 0 else 0.0, aux_b.dtype)
             dparams, dx = vjp((gy, (gloss, gaux)))
             grads = jax.tree.map(
-                lambda a, g: a + jnp.where(b_valid, g, 0).astype(a.dtype),
+                lambda a, g: _accum_add(a, jnp.where(b_valid, g, 0)),
                 grads, dparams,
             )
             loss_sum = loss_sum + jnp.where(b_valid & is_last, loss_b, 0.0)
@@ -366,7 +375,10 @@ def make_pp_train_step(
         carry0 = (
             zero_x, zero_x,
             jnp.zeros((S, b, T, cfg.d_model), dtype),
-            jax.tree.map(jnp.zeros_like, params),
+            # the accumulator is acc_dt (f32 default — matching the fused
+            # step's always-f32 buffer even for low-precision param dtypes;
+            # bfloat16 halves the param-sized carry, the 1F1B memory story)
+            jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dt), params),
             jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
         )
         (_, _, _, grads, loss_sum, aux_sum), _ = jax.lax.scan(
@@ -375,7 +387,7 @@ def make_pp_train_step(
         loss = jax.lax.psum(loss_sum, PIPE_AXIS) / M
         if cfg.n_experts > 0:
             loss = loss + jax.lax.psum(aux_sum, PIPE_AXIS) / M
-        grads = jax.tree.map(lambda g: g / M, grads)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / M, grads)
         grads = _psum_pipe_replicated(grads, _pipe_sharded_map(plan))
         return loss, grads
 

@@ -197,17 +197,13 @@ def make_train_step(
 
     acc_dt = _accum_dtype(grad_accum_dtype)
     if mesh.shape[PIPE_AXIS] > 1:
-        if acc_dt != jnp.float32:
-            raise NotImplementedError(
-                "grad_accum_dtype=bfloat16 is not plumbed through the pipeline "
-                "engine (its accumulation lives in the wavefront carries); use "
-                "float32 with pipe > 1"
-            )
         from zero_transformer_tpu.parallel.pipeline import make_pp_train_step
 
+        # 1F1B accepts bfloat16 (its accumulator is a hand-placed scan
+        # carry); GPipe rejects it there (accumulation lives in scan-VJP)
         return make_pp_train_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory,
-            pp_schedule=pp_schedule,
+            pp_schedule=pp_schedule, grad_accum_dtype=grad_accum_dtype,
         )
     # sequence x tensor x explicit-core: XLA's SPMD partitioner CHECK-fails
     # (spmd_partitioner_util.cc:495 — the same upstream crash class as
