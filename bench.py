@@ -520,7 +520,10 @@ def main() -> None:
     # budget (observed: the dots-policy compile can hang >30 min on the
     # tunneled compile helper).
     upside_timeout = float(os.environ.get("BENCH_UPSIDE_TIMEOUT", "420"))
-    for name, env_extra, timeout in (
+
+    # the two scenarios the whole capture exists for: the memory-safe 580M
+    # number and the BASELINE.json 1.3B north star.
+    HEADLINE = (
         ("remat_on", {"BENCH_REMAT": "1"}, tpu_timeout),
         # THE north-star scenario (BASELINE.json metric: "GPT-1.3B
         # tokens/sec/chip"): 1.3B on one 16 GB v5e chip needs remat +
@@ -535,7 +538,12 @@ def main() -> None:
          {"BENCH_REMAT": "1", "BENCH_MODEL": "1_3b", "BENCH_OPT": "adafactor",
           "BENCH_BATCH": "4", "BENCH_ACCUM": "16", "BENCH_LOSS_CHUNK": "256",
           "BENCH_ACCUM_DTYPE": "bfloat16"}, tpu_timeout),
-        # upside experiments, in decreasing fit-probability order.
+    )
+    # upside experiments, in decreasing fit-probability order. These run
+    # AFTER the flash/decode microbenches: a mid-window re-wedge must not
+    # cost the high-value micro datapoints (it did on 2026-07-31, when the
+    # tunnel died somewhere in the upside block).
+    UPSIDE = (
         # north_star_f32acc: the same config with the default f32 accumulator
         # — marginal on paper (~15.9 GB static); if the AOT compiler accepts
         # it, full-precision accumulation becomes the headline instead.
@@ -558,26 +566,78 @@ def main() -> None:
         ("long_ctx_8k",
          {"BENCH_REMAT": "1", "BENCH_SEQ": "8192", "BENCH_BATCH": "1",
           "BENCH_ACCUM": "8", "BENCH_LOSS_CHUNK": "1024"}, upside_timeout),
-    ):
-        if name == "north_star_b2" and any(
-            results.get(n, {}).get("ok")
-            for n in ("north_star_1_3b", "north_star_f32acc")
-        ):
-            continue  # fallback not needed: a batch-4 1.3B datapoint landed
-        if os.environ.get("BENCH_SIMULATE_HUNG") == "1":
-            res = {"ok": False, "error": "simulated: backend init hung",
-                   "backend_init_hung": True}
-        else:
-            res = _run_child("train", env_extra, timeout)
-        results[name] = res
-        if not res.get("ok"):
-            errors.append(_truncate(f"{name}: {res.get('error')}"))
-            if res.get("backend_init_hung"):
-                errors.append("skipping further TPU scenarios: backend init hung")
-                break
-        elif res.get("platform") == "cpu":
-            # no TPU visible in this environment: one CPU datapoint is enough
-            break
+    )
+
+    micros = None
+
+    def run_micros() -> dict:
+        """Flash/decode microbenches — once, at the earliest point a live
+        TPU is proven."""
+        flash = _run_child("flash", {}, 600.0)
+        if not flash.get("ok"):
+            errors.append(_truncate(f"flash: {flash.get('error')}"))
+        decode = _run_child("decode", {}, 600.0)
+        if not decode.get("ok"):
+            errors.append(_truncate(f"decode: {decode.get('error')}"))
+        # int8-KV guard (ADVICE r3): the int8 cache's HBM win rests on XLA
+        # fusing the dequant into the attention reads; if that fusion ever
+        # regresses, int8 decode tok/s falls BELOW the auto (bf16) number
+        # measured above — so the pair of datapoints is the regression alarm.
+        decode_int8 = _run_child(
+            "decode", {"BENCH_DECODE_KV": "int8", "BENCH_DECODE_SPEC": "0"}, 600.0
+        )
+        if not decode_int8.get("ok"):
+            errors.append(_truncate(f"decode_int8: {decode_int8.get('error')}"))
+        return {"flash": flash, "decode": decode, "decode_int8": decode_int8}
+
+    def run_block(scenarios, micros_at_first_tpu_ok=False) -> bool:
+        """Run train scenarios in order; False = stop the ladder (tunnel
+        hung, or a child landed on CPU — no TPU exists here). With
+        ``micros_at_first_tpu_ok`` the microbenches fire the moment a
+        scenario proves the TPU live (the upside block's edge case: both
+        headline configs failed, so the micros haven't run, and waiting for
+        the block's end risks a re-wedge eating them)."""
+        nonlocal micros
+        for name, env_extra, timeout in scenarios:
+            if name == "north_star_b2" and any(
+                results.get(n, {}).get("ok")
+                for n in ("north_star_1_3b", "north_star_f32acc")
+            ):
+                continue  # fallback not needed: a batch-4 1.3B datapoint landed
+            if os.environ.get("BENCH_SIMULATE_HUNG") == "1":
+                res = {"ok": False, "error": "simulated: backend init hung",
+                       "backend_init_hung": True}
+            else:
+                res = _run_child("train", env_extra, timeout)
+            results[name] = res
+            if not res.get("ok"):
+                errors.append(_truncate(f"{name}: {res.get('error')}"))
+                if res.get("backend_init_hung"):
+                    errors.append(
+                        "skipping further TPU scenarios: backend init hung"
+                    )
+                    return False
+            elif res.get("platform") == "cpu":
+                # no TPU visible in this environment: one datapoint is enough
+                return False
+            elif micros_at_first_tpu_ok and micros is None:
+                micros = run_micros()
+        return True
+
+    def any_tpu_ok() -> bool:
+        return any(
+            r.get("ok") and r.get("platform") == "tpu"
+            for r in results.values()
+        )
+
+    alive = run_block(HEADLINE)
+    if any_tpu_ok():
+        micros = run_micros()
+    if alive:
+        # if the first TPU success arrives only inside this block (both
+        # headline configs failed without hanging), the micros fire right
+        # there — never after a block that ended in a backend hang
+        run_block(UPSIDE, micros_at_first_tpu_ok=True)
 
     good = [r for r in results.values() if r.get("ok")]
     tpu_good = [r for r in good if r.get("platform") == "tpu"]
@@ -595,21 +655,9 @@ def main() -> None:
         ]
         best = (max(ns_good, key=lambda r: r["tok_s_chip"]) if ns_good
                 else max(tpu_good, key=lambda r: r["tok_s_chip"]))
-        flash = _run_child("flash", {}, 600.0)
-        if not flash.get("ok"):
-            errors.append(_truncate(f"flash: {flash.get('error')}"))
-        decode = _run_child("decode", {}, 600.0)
-        if not decode.get("ok"):
-            errors.append(_truncate(f"decode: {decode.get('error')}"))
-        # int8-KV guard (ADVICE r3): the int8 cache's HBM win rests on XLA
-        # fusing the dequant into the attention reads; if that fusion ever
-        # regresses, int8 decode tok/s falls BELOW the auto (bf16) number
-        # measured above — so the pair of datapoints is the regression alarm.
-        decode_int8 = _run_child(
-            "decode", {"BENCH_DECODE_KV": "int8", "BENCH_DECODE_SPEC": "0"}, 600.0
+        flash, decode, decode_int8 = (
+            micros["flash"], micros["decode"], micros["decode_int8"]
         )
-        if not decode_int8.get("ok"):
-            errors.append(_truncate(f"decode_int8: {decode_int8.get('error')}"))
         loader = _run_child("loader", {"BENCH_PLATFORM": "cpu"}, 300.0)
         if not loader.get("ok"):
             errors.append(_truncate(f"loader: {loader.get('error')}"))
