@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full bench fmt fmt-check dryrun
+.PHONY: test test-full bench bench-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -22,6 +22,12 @@ test-full:
 # One-line JSON benchmark artifact (driver contract).
 bench:
 	$(PY) bench.py
+
+# Retry the bench ladder until a live on-chip measurement lands, then promote
+# it to BENCH_measured.json (this image's TPU tunnel wedges for hours at a
+# time and clears on its own; see scripts/tpu_watch.py).
+bench-watch:
+	$(PY) scripts/tpu_watch.py
 
 # Multi-chip sharding dry-run on an 8-device virtual CPU mesh.
 dryrun:
