@@ -30,7 +30,10 @@ jax.config.update("jax_platforms", "cpu")
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
 )
-# persistent compile cache (same default as tests/conftest.py): three phases
+# persistent compile cache: tests/conftest.py exports its resolved
+# (CPU-fingerprinted) directory via JAX_TEST_COMPILATION_CACHE, so workers
+# spawned by the suite always share it; the bare fallback only applies to
+# manual standalone invocations. Three phases
 # x four processes compile the SAME programs — without this the test's
 # wall-clock is ~12 identical XLA compiles
 _cache_dir = os.path.expanduser(
