@@ -1,0 +1,58 @@
+"""Shared resolution of the persistent XLA compile-cache directory.
+
+Used by ``tests/conftest.py`` AND the standalone multihost workers so every
+process — pytest, xdist workers, spawned ``jax.distributed`` subprocesses,
+CI with its own ``JAX_TEST_COMPILATION_CACHE`` — lands in the same
+host-fingerprinted directory.
+
+The fingerprint subdirectory is applied UNCONDITIONALLY (env-provided bases
+included): cached AOT entries are only valid for the CPU feature set they
+were compiled with, and the cross-host reuse case is exactly the one where
+the base comes from the environment (CI actions/cache restoring a previous
+runner's directory; VM migrations under a fixed operator-set path).
+Observed failure modes of a stale entry: SIGILL'd xdist workers, SIGABRT
+mid-compile (2026-07-31, twice). An empty base disables caching entirely.
+"""
+from __future__ import annotations
+
+import os
+
+
+def cpu_fingerprint() -> str:
+    try:
+        import zlib  # crc32: no crypto, so FIPS-enabled hosts can't reject it
+
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 spells it "flags", aarch64 "Features"
+                if line.startswith(("flags", "Features")):
+                    return f"{zlib.crc32(line.encode()):08x}"
+    except OSError:
+        pass
+    return "nofp"
+
+
+def resolve_cache_dir() -> str:
+    """The fingerprinted cache directory, or "" when caching is disabled."""
+    base = os.path.expanduser(
+        os.environ.get(
+            "JAX_TEST_COMPILATION_CACHE", "/tmp/zero_transformer_tpu_jax_cache"
+        )
+    )
+    if not base:
+        return ""
+    return os.path.join(base, cpu_fingerprint())
+
+
+def configure(jax_module) -> str:
+    """Point jax's persistent compile cache at the resolved directory (no-op
+    when disabled); returns the directory used."""
+    cache_dir = resolve_cache_dir()
+    if cache_dir:
+        jax_module.config.update("jax_compilation_cache_dir", cache_dir)
+        # default min compile-time threshold (1s) would skip most test
+        # programs; cache everything — CPU test compiles of 2+ seconds are
+        # the norm here
+        jax_module.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax_module.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir

@@ -30,19 +30,15 @@ jax.config.update("jax_platforms", "cpu")
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
 )
-# persistent compile cache: tests/conftest.py exports its resolved
-# (CPU-fingerprinted) directory via JAX_TEST_COMPILATION_CACHE, so workers
-# spawned by the suite always share it; the bare fallback only applies to
-# manual standalone invocations. Three phases
-# x four processes compile the SAME programs — without this the test's
-# wall-clock is ~12 identical XLA compiles
-_cache_dir = os.path.expanduser(
-    os.environ.get("JAX_TEST_COMPILATION_CACHE", "/tmp/zero_transformer_tpu_jax_cache")
-)
-if _cache_dir:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# persistent compile cache, resolved by the SAME base+fingerprint rule as
+# tests/conftest.py (shared helper) — suite-spawned and standalone runs both
+# land in the host-correct directory. Three phases x four processes compile
+# the SAME programs — without this the test's wall-clock is ~12 identical
+# XLA compiles
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _compile_cache  # noqa: E402
+
+_compile_cache.configure(jax)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
