@@ -240,7 +240,14 @@ def child_decode() -> dict:
         jax.random.PRNGKey(0), (B, prompt_len), 0, cfg.vocab_size, jnp.int32
     )
     params = model.init(jax.random.PRNGKey(1), prompt[:, :8])["params"]
-    sampling = SamplingConfig(top_k=40, temperature=0.9)
+    # BENCH_DECODE_SAMPLING=greedy isolates the sampler's cost from the
+    # forward's: top-k over the [B, 50304] f32 logits runs a TPU sort each
+    # step, and the A/B against argmax says whether the decode gap to the
+    # HBM-bandwidth ceiling lives in the model or in the sampler.
+    if os.environ.get("BENCH_DECODE_SAMPLING") == "greedy":
+        sampling = SamplingConfig(greedy=True)
+    else:
+        sampling = SamplingConfig(top_k=40, temperature=0.9)
 
     t_compile = time.perf_counter()
     out = generate(model, params, prompt, new, jax.random.PRNGKey(2), sampling)
@@ -258,6 +265,15 @@ def child_decode() -> dict:
     np.asarray(out)
     dt = (time.perf_counter() - t0) / reps
 
+    # optional on-chip trace of one rep (view with xprof/tensorboard):
+    # BENCH_DECODE_PROFILE=/path/dir — for chasing the gap between measured
+    # ms/step and the weight-streaming lower bound
+    prof_dir = os.environ.get("BENCH_DECODE_PROFILE")
+    if prof_dir:
+        with jax.profiler.trace(prof_dir):
+            out = generate(model, params, prompt, new, jax.random.PRNGKey(99), sampling)
+            np.asarray(out)
+
     result = {
         "ok": True,
         "platform": platform,
@@ -268,6 +284,7 @@ def child_decode() -> dict:
         "prompt_len": prompt_len,
         "new_tokens": new,
         "kv_cache_dtype": kv_dtype,
+        "sampling": "greedy" if sampling.greedy else f"top_k={sampling.top_k}",
         "compile_seconds": round(t_compile, 1),
         "note": "wall time includes one prefill per rep",
     }
