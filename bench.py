@@ -548,7 +548,8 @@ def main() -> None:
         # tokens/sec/chip"): 1.3B on one 16 GB v5e chip needs remat +
         # adafactor (adamw's 12 bytes/param of state would never fit) AND a
         # bfloat16 grad-accumulation buffer: the 2026-07-31 live window
-        # proved (AOT-compile HBM rejection, runs/bench_r5_live1.json) that
+        # proved (AOT-compile HBM rejection, recorded in BENCH_measured.json's
+        # north_star_f32acc scenario) that
         # three param-sized f32 trees — master params, accumulator,
         # micro-grads — are 15.6 GB before activations. bf16 accumulator +
         # chunked CE + batch 4 brings the static picture to ~13 GB.
