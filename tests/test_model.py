@@ -151,7 +151,7 @@ def test_scan_and_loop_layers_match():
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_l), atol=2e-5)
 
 
-@pytest.mark.parametrize("policy", ["none", "dots"])
+@pytest.mark.parametrize("policy", ["none", "dots", "qkv_mlp"])
 def test_remat_matches_no_remat(policy):
     cfg = dataclasses.replace(TEST_CFG, remat=True, remat_policy=policy)
     model_r = Transformer(cfg)
@@ -170,6 +170,47 @@ def test_remat_matches_no_remat(policy):
 
     gr = jax.grad(loss(model_r))(params)
     gn = jax.grad(loss(model_n))(params)
+    for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(gn)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_remat_policy_resolver_shared():
+    """Both step builders (Transformer and the pipeline stage builder) take
+    their checkpoint policy from the ONE resolver, so every policy name the
+    config accepts must resolve — a name that fell back to None here would
+    silently degrade to save-nothing remat (the round-5 review catch)."""
+    from zero_transformer_tpu.models.gpt import resolve_remat_policy
+
+    assert resolve_remat_policy(dataclasses.replace(TEST_CFG, remat_policy="none")) is None
+    for name in ("dots", "qkv_mlp"):
+        cfg = dataclasses.replace(TEST_CFG, remat=True, remat_policy=name)
+        assert resolve_remat_policy(cfg) is not None, name
+
+
+def test_remat_qkv_mlp_matches_on_moe():
+    """The named-save policy must be numerically inert on MoE blocks too
+    (MoEMLP carries its own mlp_wi/mlp_gate checkpoint_name sites)."""
+    cfg = dataclasses.replace(
+        TEST_CFG, n_experts=2, moe_top_k=1, activation="swiglu",
+        remat=True, remat_policy="qkv_mlp",
+    )
+    base = dataclasses.replace(cfg, remat=False, remat_policy="none")
+    x = jnp.zeros((1, 8), jnp.int32)
+    params = Transformer(base).init(jax.random.PRNGKey(0), x)
+
+    def f(model):
+        def loss(p):
+            out = model.apply(p, x)
+            out = out[0] if isinstance(out, tuple) else out
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return loss
+
+    np.testing.assert_allclose(
+        np.asarray(f(Transformer(cfg))(params)),
+        np.asarray(f(Transformer(base))(params)), atol=1e-6,
+    )
+    gr = jax.grad(f(Transformer(cfg)))(params)
+    gn = jax.grad(f(Transformer(base)))(params)
     for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(gn)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 

@@ -24,6 +24,7 @@ from typing import Any, Optional, Tuple, Union
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.nn import initializers
 
 from zero_transformer_tpu.config import ModelConfig, resolve_dtype
@@ -80,6 +81,31 @@ def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
     return q, scale
+
+
+def resolve_remat_policy(cfg: ModelConfig):
+    """cfg.remat_policy → jax.checkpoint saveable-policy.
+
+    The ONE mapping, shared by the plain Transformer and the pipeline stage
+    builder (parallel/pipeline.py) so the two step paths cannot diverge.
+
+    - "none": save nothing — max HBM savings, the whole block re-forwards in
+      the backward (minus dead code: the out/wo projection OUTPUTS are never
+      needed, so they are not recomputed even here).
+    - "dots": save every no-batch-dim matmul output
+      (``dots_with_no_batch_dims_saveable``).
+    - "qkv_mlp": save only the named q/k/v and MLP pre-activation tensors
+      (``checkpoint_name`` sites in Attention/MLP/MoEMLP) — roughly a third
+      of the dots footprint while still skipping ~85% of the re-forward
+      matmul FLOPs, which are dominated by the qkv and wi projections.
+    """
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "qkv_mlp":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_q", "attn_k", "attn_v", "mlp_wi", "mlp_gate"
+        )
+    return None
 
 
 def _norm(cfg: ModelConfig, dtype, name: str):
@@ -144,6 +170,15 @@ class Attention(nn.Module):
         q = constrain_activation(q.reshape(B, T, H, D), "batch", "seq", "heads", "head_dim")
         k = constrain_activation(k.reshape(B, T, KVH, D), "batch", "seq", "kvheads", "head_dim")
         v = constrain_activation(v.reshape(B, T, KVH, D), "batch", "seq", "kvheads", "head_dim")
+        # remat_policy="qkv_mlp" saves these three (plus the MLP
+        # pre-activations) across the forward: the flash kernel's backward
+        # needs q/k/v as residuals anyway, so saving them skips the qkv
+        # projections' recompute — the bulk of the attention-side re-forward
+        # — for ~38 MB/layer (bf16, batch 4 x 1024 x d1536). Outside remat
+        # checkpoint_name is a no-op.
+        q = checkpoint_name(q, "attn_q")
+        k = checkpoint_name(k, "attn_k")
+        v = checkpoint_name(v, "attn_v")
 
         use_cache = False
         offset = 0
@@ -242,8 +277,15 @@ class MLP(nn.Module):
             _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "wi")(x),
             "batch", "seq", "mlp",
         )
+        # saved under remat_policy="qkv_mlp": wo's weight gradient needs
+        # act(h) — saving the pre-activation skips the wi (and gate) matmul
+        # recompute, the largest single matmul in the block's re-forward
+        h = checkpoint_name(h, "mlp_wi")
         if cfg.activation == "swiglu":
-            g = _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "gate")(x)
+            g = checkpoint_name(
+                _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "gate")(x),
+                "mlp_gate",
+            )
             h = nn.silu(g) * h
         else:
             h = nn.gelu(h)
@@ -379,16 +421,9 @@ class Transformer(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            # "dots": save matmul outputs, recompute only cheap elementwise
-            # ops in the backward — a faster point on the remat memory/FLOPs
-            # curve than save-nothing when HBM allows
-            policy = (
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                if cfg.remat_policy == "dots"
-                else None
-            )
             block_cls = nn.remat(
-                Block, prevent_cse=not cfg.scan_layers, policy=policy
+                Block, prevent_cse=not cfg.scan_layers,
+                policy=resolve_remat_policy(cfg),
             )
         aux = jnp.zeros((), jnp.float32)  # MoE router losses, summed over layers
         packed = cfg.doc_sep_token is not None and not self.decode

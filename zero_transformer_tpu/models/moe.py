@@ -28,6 +28,7 @@ from typing import Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.nn import initializers
 
 from zero_transformer_tpu.config import ModelConfig, resolve_dtype
@@ -139,7 +140,13 @@ class MoEMLP(nn.Module):
         # dispatch: [B,T,d] tokens -> [E,B,C,d] expert buffers (all-to-all
         # over the expert axis when sharded)
         xin = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)
-        h = jnp.einsum("ebcd,edf->ebcf", xin, wi.astype(dtype))
+        # named for remat_policy="qkv_mlp" (models/gpt.py
+        # resolve_remat_policy): saving the expert pre-activations skips the
+        # dispatch + wi einsum recompute — the dominant MoE re-forward cost —
+        # exactly as saving mlp_wi does in the dense MLP
+        h = checkpoint_name(
+            jnp.einsum("ebcd,edf->ebcf", xin, wi.astype(dtype)), "mlp_wi"
+        )
         if cfg.activation == "swiglu":
             wg = self.param(
                 "gate",
@@ -149,7 +156,9 @@ class MoEMLP(nn.Module):
                 (E, d, f),
                 param_dtype,
             )
-            g = jnp.einsum("ebcd,edf->ebcf", xin, wg.astype(dtype))
+            g = checkpoint_name(
+                jnp.einsum("ebcd,edf->ebcf", xin, wg.astype(dtype)), "mlp_gate"
+            )
             h = nn.silu(g) * h
         else:
             h = nn.gelu(h)

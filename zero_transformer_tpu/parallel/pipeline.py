@@ -123,6 +123,7 @@ def make_pp_train_step(
         _norm,
         doc_ids_from_tokens,
         mask_boundary_labels,
+        resolve_remat_policy,
     )
     from zero_transformer_tpu.parallel.mesh import TENSOR_AXIS
     from zero_transformer_tpu.parallel.zero import TrainState, _accum_add, _accum_dtype
@@ -193,15 +194,13 @@ def make_pp_train_step(
     )
     block_cls = Block
     if cfg.remat:
-        # same per-block checkpointing (and policy) as the plain path
-        # (models/gpt.py) — bounds the activations stashed across the
-        # M+P-1 wavefront ticks
-        policy = (
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            if cfg.remat_policy == "dots"
-            else None
+        # same per-block checkpointing (and policy) as the plain path —
+        # resolve_remat_policy is the shared mapping, so a policy added in
+        # models/gpt.py cannot silently degrade to None here — bounds the
+        # activations stashed across the M+P-1 wavefront ticks
+        block_cls = nn.remat(
+            Block, prevent_cse=False, policy=resolve_remat_policy(cfg)
         )
-        block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
     stage_mod = nn.scan(
         block_cls,
         variable_axes={"params": 0},
