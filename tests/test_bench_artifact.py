@@ -171,7 +171,17 @@ def test_ladder_micros_before_upsides_and_b2_skip(monkeypatch, capsys):
     # upsides is exactly the re-wedge exposure this test pins
     i_first_upside = [i for i, s in enumerate(order) if s == "train"][2]
     assert i_flash < i_first_upside, "micros must precede ALL upside scenarios"
-    assert not any(e.get("BENCH_BATCH") == "2" for _, e in calls)
+    # the batch-2 INSURANCE scenario (north_star_b2: batch 2, default remat
+    # policy) must be skipped; the batch-2 qkv_mlp POLICY upside still runs —
+    # it exists to move the landed datapoint, not to replace a missing one
+    assert not any(
+        e.get("BENCH_BATCH") == "2" and "BENCH_REMAT_POLICY" not in e
+        for _, e in calls
+    )
+    assert any(
+        e.get("BENCH_BATCH") == "2" and e.get("BENCH_REMAT_POLICY") == "qkv_mlp"
+        for _, e in calls
+    ), "the batch-2 qkv_mlp POLICY upside must not be caught by the skip"
     assert art["metric"] == "train_tokens_per_sec_per_chip_1_3b"
     assert art["value"] == 9000.0
 
