@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full bench bench-watch fmt fmt-check dryrun
+.PHONY: test test-full bench bench-watch e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -28,6 +28,11 @@ bench:
 # time and clears on its own; see scripts/tpu_watch.py).
 bench-watch:
 	$(PY) scripts/tpu_watch.py
+
+# Same, for the on-chip e2e quality run (prepare -> train -> eval -> serve):
+# retries until docs/e2e/full_tpu/eval.json lands.
+e2e-watch:
+	bash scripts/e2e_watch.sh
 
 # Multi-chip sharding dry-run on an 8-device virtual CPU mesh.
 dryrun:
