@@ -244,10 +244,18 @@ def child_decode() -> dict:
     # forward's: top-k over the [B, 50304] f32 logits runs a TPU sort each
     # step, and the A/B against argmax says whether the decode gap to the
     # HBM-bandwidth ceiling lives in the model or in the sampler.
-    if os.environ.get("BENCH_DECODE_SAMPLING") == "greedy":
+    # =topk_approx runs the same top-k through lax.approx_max_k (the TPU
+    # partial-reduce) — the third arm that says how much of the sort cost
+    # the approximate cutoff recovers.
+    arm = os.environ.get("BENCH_DECODE_SAMPLING", "topk")
+    if arm == "greedy":
         sampling = SamplingConfig(greedy=True)
-    else:
+    elif arm == "topk_approx":
+        sampling = SamplingConfig(top_k=40, temperature=0.9, top_k_impl="approx")
+    elif arm == "topk":
         sampling = SamplingConfig(top_k=40, temperature=0.9)
+    else:  # a typo'd arm must not silently benchmark the wrong thing
+        raise ValueError(f"BENCH_DECODE_SAMPLING={arm!r} (topk|topk_approx|greedy)")
 
     t_compile = time.perf_counter()
     out = generate(model, params, prompt, new, jax.random.PRNGKey(2), sampling)
@@ -284,7 +292,8 @@ def child_decode() -> dict:
         "prompt_len": prompt_len,
         "new_tokens": new,
         "kv_cache_dtype": kv_dtype,
-        "sampling": "greedy" if sampling.greedy else f"top_k={sampling.top_k}",
+        "sampling": ("greedy" if sampling.greedy
+                     else f"top_k={sampling.top_k}:{sampling.top_k_impl}"),
         "compile_seconds": round(t_compile, 1),
         "note": "wall time includes one prefill per rep",
     }

@@ -45,6 +45,36 @@ def test_top_k_disabled():
     np.testing.assert_array_equal(top_k_filter(logits, 3), logits)
 
 
+def test_top_k_approx_is_softer_never_harder():
+    """The approx arm (lax.approx_max_k partial-reduce) thresholds at the
+    approximate k-th value, which is <= the exact one: every token the
+    exact filter keeps must survive the approx filter, and the approx kept
+    set may only be wider — never narrower."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 4096)).astype(np.float32))
+    k = 40
+    exact = top_k_filter(logits, k)
+    approx = top_k_filter(logits, k, impl="approx")
+    exact_kept = np.asarray(exact) > -1e9
+    approx_kept = np.asarray(approx) > -1e9
+    assert (approx_kept >= exact_kept).all(), "approx filter dropped a true top-k token"
+    # kept values pass through unchanged (only the cutoff differs)
+    np.testing.assert_array_equal(
+        np.asarray(approx)[approx_kept], np.asarray(logits)[approx_kept]
+    )
+    # sanity: the widening is bounded in practice (recall target ~0.95)
+    assert approx_kept.sum() <= 4 * 3 * k
+
+
+def test_sampling_config_rejects_bad_top_k_impl():
+    import pytest as _pytest
+
+    from zero_transformer_tpu.inference.sampling import SamplingConfig
+
+    with _pytest.raises(ValueError):
+        SamplingConfig(top_k_impl="fast")
+
+
 def test_top_p_keeps_nucleus():
     # probs ~ [0.64, 0.24, 0.09, 0.03]; p=0.7 keeps the first two (first token
     # always kept, second kept because cumulative mass before it is < p)

@@ -58,10 +58,15 @@ class TextGenerator:
     """Tokenizer + params + compiled decode loop behind one ``__call__``."""
 
     def __init__(self, cfg, params: Any, tokenizer, cache_len: Optional[int] = None,
-                 speculative: int = 0, tensor: int = 1):
+                 speculative: int = 0, tensor: int = 1,
+                 top_k_impl: str = "exact"):
         from zero_transformer_tpu.inference import decode_model
 
         self.cfg = cfg
+        # server-level execution knob, not a per-request sampling semantic:
+        # "approx" swaps the per-step vocab sort for lax.approx_max_k (TPU
+        # partial-reduce; kept set can be slightly wider than k)
+        self.top_k_impl = top_k_impl
         self.tokenizer = tokenizer
         self.cache_len = cache_len or cfg.max_seq_len
         self.model = decode_model(cfg, self.cache_len)
@@ -166,6 +171,7 @@ class TextGenerator:
         sampling = SamplingConfig(
             temperature=temperature, top_k=top_k, top_p=top_p,
             repetition_penalty=repetition_penalty, greedy=greedy,
+            top_k_impl=self.top_k_impl,
         )
         return ids, sampling, self.tokenizer.eos_token_id
 
@@ -225,6 +231,7 @@ def _build_generator(args) -> TextGenerator:
     return TextGenerator(
         cfg, params, tokenizer, cache_len=args.cache_len,
         speculative=args.speculative, tensor=args.tensor,
+        top_k_impl="approx" if args.approx_top_k else "exact",
     )
 
 
@@ -308,6 +315,10 @@ def main(argv=None) -> None:
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--approx-top-k", action="store_true",
+                   help="use the TPU partial-reduce (lax.approx_max_k) for "
+                        "the top-k cutoff instead of the exact vocab sort; "
+                        "the kept set can be slightly wider than k")
     p.add_argument("--top-p", type=float, default=0.9)
     p.add_argument("--repetition-penalty", type=float, default=1.1)
     p.add_argument("--greedy", action="store_true")
