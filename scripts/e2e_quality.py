@@ -228,6 +228,11 @@ def main() -> None:
     ap.add_argument("--out", default="runs/e2e")
     ap.add_argument("--force-cpu", action="store_true",
                     help="pin the cpu platform (smoke defaults to this)")
+    ap.add_argument("--on-chip", action="store_true",
+                    help="smoke mode: run train/eval on the default (TPU) "
+                         "backend instead of smoke's CPU pin — a ~3-minute "
+                         "on-chip proof of the whole loop for windows too "
+                         "short for the full byte_25m run")
     ap.add_argument("--steps", type=int, default=None,
                     help="override training.total_steps (full mode: right-size "
                          "the on-chip run to the available window)")
@@ -242,6 +247,11 @@ def main() -> None:
     out = Path(args.out)
     data_dir = out / "data"
     smoke = args.mode == "smoke"
+    if args.on_chip and not smoke:
+        raise SystemExit(
+            "--on-chip is a smoke-mode option (full mode already runs on the "
+            "default backend); drop --mode full or drop --on-chip"
+        )
     cap = 2 << 20 if smoke else 64 << 20
 
     # fresh run state: metrics.jsonl is an append-mode sink and orbax
@@ -313,9 +323,12 @@ def main() -> None:
     for kv in args.extra_set:
         overrides += ["--set", kv]
     env = dict(os.environ)
+    # --on-chip lifts smoke's CPU pin (train + eval on the default backend);
+    # an explicit --force-cpu still wins
+    pin_cpu = (smoke and not args.on_chip) or args.force_cpu
     code = (
         "import jax\n"
-        + ("jax.config.update('jax_platforms','cpu')\n" if (smoke or args.force_cpu) else "")
+        + ("jax.config.update('jax_platforms','cpu')\n" if pin_cpu else "")
         + "import sys; import train\n"
         "sys.argv = ['train.py', '--cfg', 'configs/train_e2e_bytes.yaml'] + "
         f"{overrides!r}\n"
@@ -331,7 +344,7 @@ def main() -> None:
 
     # --- eval: byte ppl, bits-per-byte, last-word accuracy
     model_name = "test" if smoke else (args.model or "byte_25m")
-    force_cpu = smoke or args.force_cpu
+    force_cpu = pin_cpu
     results = {}
     eval_common = ["--model", model_name, "--params", params,
                    "--seq-len", ctx,

@@ -1,34 +1,55 @@
 #!/bin/bash
-# Retry the full on-chip e2e quality run until its artifacts land.
+# Retry the on-chip e2e quality run until its artifacts land.
 #
 # Same philosophy as scripts/tpu_watch.py (the bench-ladder watcher): this
 # image's TPU tunnel wedges at backend init for stretches and clears on its
-# own, so the cheapest robust automation is run → inspect → retry. Each
-# attempt is backstop-killed (a wedged backend-init otherwise blocks
-# forever) and success is judged by the artifacts, not the exit code:
-# sample.txt is written LAST by e2e_quality.py, so its presence (plus
-# eval.json) means the whole prepare→train→eval→serve chain completed.
+# own. Two hardenings beyond run→retry:
+#   1. PROBE-GATED: a 120s jax.devices() probe decides whether the tunnel
+#      is worth an attempt — a wedged backend-init otherwise burns ~25 min
+#      of the cycle before failing.
+#   2. SMOKE BANKING: on the first live probe, the ~4-minute smoke-size
+#      on-chip loop (e2e_quality.py --mode smoke --on-chip) runs before the
+#      ~13-minute full byte_25m run, so even a window too short for the
+#      full run leaves a committed-grade on-chip artifact.
+# Success is judged by the artifacts, not exit codes: sample.txt is written
+# LAST by e2e_quality.py, so eval.json + sample.txt means the whole
+# prepare→train→eval→serve chain completed.
 #
-# Usage: bash scripts/e2e_watch.sh [OUT_DIR] [ATTEMPTS] [ATTEMPT_TIMEOUT_S]
+# Usage: bash scripts/e2e_watch.sh [OUT_DIR] [CYCLES] [FULL_TIMEOUT_S]
 set -u
 OUT=${1:-docs/e2e/full_tpu}
-ATTEMPTS=${2:-20}
+CYCLES=${2:-60}
 TMO=${3:-2400}
+SMOKE_OUT=${SMOKE_OUT:-docs/e2e/smoke_tpu_live}
 cd "$(dirname "$0")/.."
 mkdir -p runs
 # a stale artifact from a previous run must not count as this run's success
-rm -f "$OUT/eval.json" "$OUT/sample.txt"
-for i in $(seq 1 "$ATTEMPTS"); do
-  echo "[$(date +%H:%M:%S)] e2e attempt $i -> $OUT" | tee -a runs/e2e_watch.log
-  timeout -k 30 "$TMO" python scripts/e2e_quality.py --mode full --out "$OUT" \
-    > "runs/e2e_full_tpu_$i.log" 2>&1
-  rc=$?
-  echo "[$(date +%H:%M:%S)] attempt $i rc=$rc (runs/e2e_full_tpu_$i.log)" | tee -a runs/e2e_watch.log
-  if [ -f "$OUT/eval.json" ] && [ -f "$OUT/sample.txt" ]; then
-    echo "E2E DONE: $OUT" | tee -a runs/e2e_watch.log
-    exit 0
+rm -f "$OUT/eval.json" "$OUT/sample.txt" "$SMOKE_OUT/eval.json" "$SMOKE_OUT/sample.txt"
+probe() {
+  timeout -k 10 120 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform != "cpu"
+EOF
+}
+for i in $(seq 1 "$CYCLES"); do
+  if probe; then
+    echo "[$(date +%H:%M:%S)] probe LIVE (cycle $i)" | tee -a runs/e2e_watch.log
+    if [ ! -f "$SMOKE_OUT/eval.json" ] || [ ! -f "$SMOKE_OUT/sample.txt" ]; then
+      timeout -k 30 900 python scripts/e2e_quality.py --mode smoke --on-chip \
+        --out "$SMOKE_OUT" > "runs/e2e_smoke_tpu_$i.log" 2>&1
+      echo "[$(date +%H:%M:%S)] smoke-on-chip rc=$?" | tee -a runs/e2e_watch.log
+    fi
+    timeout -k 30 "$TMO" python scripts/e2e_quality.py --mode full --out "$OUT" \
+      > "runs/e2e_full_tpu_$i.log" 2>&1
+    echo "[$(date +%H:%M:%S)] full rc=$? (runs/e2e_full_tpu_$i.log)" | tee -a runs/e2e_watch.log
+    if [ -f "$OUT/eval.json" ] && [ -f "$OUT/sample.txt" ]; then
+      echo "E2E DONE: $OUT" | tee -a runs/e2e_watch.log
+      exit 0
+    fi
+  else
+    echo "[$(date +%H:%M:%S)] probe wedged (cycle $i)" | tee -a runs/e2e_watch.log
   fi
-  sleep 300
+  sleep 240
 done
-echo "e2e watcher: out of attempts" | tee -a runs/e2e_watch.log
+echo "e2e watcher: out of cycles" | tee -a runs/e2e_watch.log
 exit 1

@@ -49,7 +49,15 @@ def test_top_k_approx_is_softer_never_harder():
     """The approx arm (lax.approx_max_k partial-reduce) thresholds at the
     approximate k-th value, which is <= the exact one: every token the
     exact filter keeps must survive the approx filter, and the approx kept
-    set may only be wider — never narrower."""
+    set may only be wider — never narrower.
+
+    Honesty note: on CPU (where this suite runs) approx_max_k falls back
+    to the exact sort, so here the assertions pin the PLUMBING (the impl
+    switch routes, kept values pass through, superset trivially holds).
+    The approximate-cutoff behavior itself only diverges on TPU, where the
+    same superset property is a theorem (the min of k returned true values
+    is <= the exact k-th value) rather than something this test can
+    falsify."""
     rng = np.random.default_rng(0)
     logits = jnp.asarray(rng.normal(size=(4, 4096)).astype(np.float32))
     k = 40
