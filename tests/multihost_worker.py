@@ -105,6 +105,29 @@ def main():
     np.testing.assert_allclose(norm_after, norm_before, rtol=1e-6)
     mgr.close()
 
+    # hybrid (DCN) mesh: 2 process granules x 4 devices -> the data axis
+    # must be ordered granule-major (indices 0-3 one process, 4-7 the
+    # other), i.e. only the outer half of the data axis crosses the slow
+    # network — the layout dcn_data exists to guarantee
+    hybrid = make_mesh(MeshConfig(data=8, dcn_data=2))
+    dev_grid = hybrid.devices  # (pipe, data, fsdp, expert, tensor, sequence)
+    assert dev_grid.shape == (1, 8, 1, 1, 1, 1), dev_grid.shape
+    row = dev_grid[0, :, 0, 0, 0, 0]
+    first = {d.process_index for d in row[:4]}
+    second = {d.process_index for d in row[4:]}
+    assert len(first) == 1 and len(second) == 1 and first != second, (
+        f"hybrid data axis not granule-major: {[d.process_index for d in row]}"
+    )
+    # and it actually computes: a cross-granule reduction over the hybrid
+    # mesh's sharded data axis
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ones = jax.device_put(
+        np.ones((8,), np.float32), NamedSharding(hybrid, P("data"))
+    )
+    s = float(jax.jit(lambda x: x.sum())(ones))
+    assert s == 8.0, s
+
     print(f"process {jax.process_index()}: losses={losses}", flush=True)
     print("WORKER_OK", flush=True)
 

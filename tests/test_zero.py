@@ -55,6 +55,20 @@ def test_mesh_axes(devices):
         make_mesh(MeshConfig(data=3))
 
 
+def test_hybrid_mesh_validation(devices):
+    """dcn_data (multi-slice DCN layout) must fail LOUDLY when the devices
+    cannot honor it: single-process virtual CPU devices form one granule,
+    so asking for 2 DCN groups must raise (never silently produce a mesh
+    whose tensor axis would cross the slow network)."""
+    with pytest.raises(ValueError, match="dcn_data"):
+        MeshConfig(dcn_data=0)
+    with pytest.raises(ValueError, match="not divisible by dcn_data"):
+        make_mesh(MeshConfig(data=8, dcn_data=3))
+    with pytest.raises(ValueError, match="hybrid mesh"):
+        # 8 devices, all process 0 / no slice_index -> 1 granule != 2
+        make_mesh(MeshConfig(data=8, dcn_data=2))
+
+
 @pytest.mark.parametrize("zero_stage", [0, 1, 2, 3])
 def test_loss_decreases_all_stages(zero_stage):
     mesh, model, plan, state, step = _setup(zero_stage=zero_stage)

@@ -196,6 +196,14 @@ class MeshConfig:
     tensor: int = 1
     pipe: int = 1  # GPipe pipeline stages (layer sharding + ppermute wavefront)
     sequence: int = 1
+    # Multi-slice / multi-pod placement: number of DCN-connected device
+    # groups (TPU slices, or processes on platforms without slice_index)
+    # that the GLOBAL data axis spans. The per-step gradient all-reduce is
+    # the only collective that crosses groups; every model axis (fsdp,
+    # expert, tensor, sequence, pipe) stays inside one ICI domain — the
+    # scaling-book layout (DCN outermost, ICI inner). 1 = single slice
+    # (plain topology-aware mesh); must divide `data`.
+    dcn_data: int = 1
     # ZeRO stage: 0 = plain DP, 1 = opt-state sharded, 2 = +grad reduce-scatter,
     # 3 = +param sharded (FSDP). Reference implements stage 1 only (SURVEY §2).
     zero_stage: int = 1
@@ -206,6 +214,8 @@ class MeshConfig:
     pp_schedule: str = "gpipe"
 
     def __post_init__(self):
+        if self.dcn_data < 1:
+            raise ValueError(f"dcn_data must be >= 1, got {self.dcn_data}")
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"pp_schedule must be 'gpipe' or '1f1b', got {self.pp_schedule!r}"

@@ -53,6 +53,8 @@ def make_mesh(
         )
     # pipe leads: stage boundaries land on the slowest interconnect dimension
     shape = (cfg.pipe, data, cfg.fsdp, cfg.expert, cfg.tensor, cfg.sequence)
+    if cfg.dcn_data > 1:
+        return _hybrid_mesh(cfg, data, devices)
     try:
         # topology-aware placement: keeps collective-heavy axes on adjacent
         # ICI links on real TPU slices
@@ -62,6 +64,45 @@ def make_mesh(
     except Exception:
         arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, AXES)
+
+
+def _hybrid_mesh(cfg: MeshConfig, data: int, devices) -> Mesh:
+    """Multi-slice mesh: the data axis spans ``dcn_data`` DCN-connected
+    groups; all model axes stay inside one ICI domain each (the
+    scaling-book layout — only the per-step gradient reduction crosses the
+    slow network). Uses TPU ``slice_index`` granules when the platform
+    provides them, falling back to process granules (multi-host CPU, or
+    single-slice-per-host topologies). Loud on any mismatch: a user who
+    asked for a DCN layout must not silently get a DCN-crossing tensor
+    axis instead."""
+    from jax.experimental import mesh_utils
+
+    if data % cfg.dcn_data:
+        raise ValueError(
+            f"data={data} not divisible by dcn_data={cfg.dcn_data}"
+        )
+    ici_shape = (
+        cfg.pipe, data // cfg.dcn_data, cfg.fsdp, cfg.expert, cfg.tensor,
+        cfg.sequence,
+    )
+    dcn_shape = (1, cfg.dcn_data, 1, 1, 1, 1)
+    errs = []
+    for process_is_granule in (False, True):
+        try:
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices,
+                process_is_granule=process_is_granule,
+            )
+            return Mesh(arr, AXES)
+        except Exception as e:  # noqa: BLE001 — jax raises ValueError for
+            # granule mismatches but NotImplementedError/AssertionError for
+            # unplaceable per-granule topologies; all of them must reach the
+            # combined loud error below, not escape raw mid-fallback
+            errs.append(f"{type(e).__name__}: {e}")
+    raise ValueError(
+        f"cannot build hybrid mesh (ici={ici_shape}, dcn={dcn_shape}) over "
+        f"{len(devices)} devices: {' | '.join(errs)}"
+    )
 
 
 def zero_axes(mesh: Mesh) -> tuple[str, ...]:
