@@ -62,6 +62,14 @@ def main():
         help="capture a jax.profiler trace of N steps (after the compile step)",
     )
     parser.add_argument(
+        "--memory-analysis",
+        action="store_true",
+        default=False,
+        help="AOT-compile the train step and print the compiled HBM "
+        "breakdown (state/temps/peak), then exit — nothing is allocated "
+        "or executed. The pre-flight for sizing a config to a 16 GB chip.",
+    )
+    parser.add_argument(
         "--debug-nans",
         action="store_true",
         default=False,
@@ -103,6 +111,21 @@ def main():
         jax.process_count(),
         jax.default_backend(),
     )
+    if args.memory_analysis:
+        import json
+
+        from zero_transformer_tpu.training.trainer import memory_analysis
+
+        report = memory_analysis(cfg)
+        gb = 1 << 30
+        for k in sorted(report):
+            v = report[k]
+            logging.info(
+                "memory-analysis %s = %s", k,
+                f"{v / gb:.2f} GiB" if "_bytes" in k and isinstance(v, int) else v,
+            )
+        print(json.dumps(report), flush=True)
+        return
     trainer = Trainer(cfg, use_wandb=args.wandb)
     try:
         trainer.train(max_steps=args.max_steps)

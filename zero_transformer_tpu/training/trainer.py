@@ -38,6 +38,116 @@ from zero_transformer_tpu.utils import monitoring
 log = logging.getLogger("zero_transformer_tpu")
 
 
+@dataclasses.dataclass(frozen=True)
+class TrainingBuild:
+    """Mesh → model → optimizer → plan → compiled-step builders for a config.
+
+    The data-free, side-effect-free half of Trainer construction, factored
+    out so the ``--memory-analysis`` surface (and tests) can build the real
+    train step without touching loaders or checkpoint directories."""
+
+    mesh: Any
+    model: Transformer
+    schedule: Any
+    tx: Any
+    plan: Any
+    train_step: Any
+    eval_step: Any
+    sample_shape: tuple
+
+
+def build_training(cfg: Config, mesh=None) -> TrainingBuild:
+    mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+    opt = dataclasses.replace(cfg.optimizer, total_steps=cfg.training.total_steps)
+    # an active sequence axis routes attention through the ring-attention
+    # context-parallel path (ops/ring_attention.py)
+    from zero_transformer_tpu.parallel.mesh import SEQUENCE_AXIS
+
+    seq_parallel = mesh.shape[SEQUENCE_AXIS] > 1
+    model = Transformer(cfg.model, mesh=mesh if seq_parallel else None)
+    schedule = make_schedule(opt)
+    tx = make_optimizer(opt, schedule)
+
+    sample_shape = (cfg.training.batch_size, cfg.training.train_context)
+    plan = make_plan(model, tx, mesh, sample_shape, cfg.mesh.zero_stage)
+    train_step = make_train_step(
+        model,
+        tx,
+        mesh,
+        plan,
+        cfg.mesh.zero_stage,
+        schedule,
+        # lets the explicit ZeRO-2/3 core rebuild the optimizer with a
+        # shard-aware grad-clip norm (same opt-state structure)
+        tx_factory=lambda norm_fn, zc=None: make_optimizer(
+            opt, schedule, norm_fn, zero_collectives=zc
+        ),
+        pp_schedule=cfg.mesh.pp_schedule,
+        grad_accum_dtype=cfg.training.grad_accum_dtype,
+    )
+    eval_step = make_eval_step(model, mesh, plan)
+    return TrainingBuild(
+        mesh=mesh, model=model, schedule=schedule, tx=tx, plan=plan,
+        train_step=train_step, eval_step=eval_step, sample_shape=sample_shape,
+    )
+
+
+def memory_analysis(cfg: Config, accum: Optional[int] = None) -> Dict[str, Any]:
+    """AOT-compile the train step for ``cfg`` and report the compiled memory
+    picture — no state is materialized and nothing executes. The tool behind
+    sizing runs for a 16 GB chip (see docs/DESIGN.md "The 16 GB budget"):
+    the same HBM accounting the AOT compiler enforces when it rejects a
+    config, exposed BEFORE a multi-minute failed launch.
+
+    Compiled sizes (argument/output/temp/alias/peak) are PER DEVICE —
+    exactly what must fit one chip's HBM; the ``*_global`` keys are the
+    logical whole-tree sizes. Backends without ``memory_analysis`` support
+    fall back to the shape-derived global totals with ``"exact": False``."""
+    b = build_training(cfg)
+    abstract = ckpt_lib.abstract_state(b.model, b.tx, b.plan, b.sample_shape)
+    accum = accum or cfg.training.gradient_accumulation_steps
+    batch = jax.ShapeDtypeStruct((accum, *b.sample_shape), jnp.int32)
+    rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    compiled = b.train_step.lower(abstract, batch, rng).compile()
+
+    def _tree_bytes(tree) -> int:
+        return sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(tree)
+        )
+
+    # GLOBAL logical sizes; the compiled numbers below are PER DEVICE (a
+    # ZeRO-sharded opt state divides across the mesh, so on n devices
+    # alias/argument bytes are roughly params + sharded-state/n each)
+    out = {
+        "state_bytes_global": _tree_bytes(abstract),
+        "batch_bytes_global": _tree_bytes(batch),
+        "n_devices": len(b.mesh.devices.ravel()),
+        "tokens_per_step": accum * b.sample_shape[0] * b.sample_shape[1],
+    }
+    try:
+        ma = compiled.memory_analysis()
+        out.update(
+            exact=True,
+            argument_bytes=ma.argument_size_in_bytes,
+            output_bytes=ma.output_size_in_bytes,
+            temp_bytes=ma.temp_size_in_bytes,
+            alias_bytes=ma.alias_size_in_bytes,
+            generated_code_bytes=ma.generated_code_size_in_bytes,
+            # donated state aliases in place, so the live peak is roughly
+            # arguments (incl. state) + temps − aliased output
+            peak_estimate_bytes=(
+                ma.argument_size_in_bytes
+                + ma.temp_size_in_bytes
+                + ma.output_size_in_bytes
+                - ma.alias_size_in_bytes
+            ),
+        )
+    except Exception as e:  # backend without memory_analysis (CPU)
+        out.update(exact=False, unavailable_reason=f"{type(e).__name__}: {e}")
+    return out
+
+
 class Trainer:
     def __init__(
         self,
@@ -48,38 +158,16 @@ class Trainer:
         use_wandb: bool = False,
     ):
         self.cfg = cfg
-        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+        build = build_training(cfg, mesh=mesh)
+        self.mesh = build.mesh
         self.zero_stage = cfg.mesh.zero_stage
-        opt = dataclasses.replace(cfg.optimizer, total_steps=cfg.training.total_steps)
-        # an active sequence axis routes attention through the ring-attention
-        # context-parallel path (ops/ring_attention.py)
-        from zero_transformer_tpu.parallel.mesh import SEQUENCE_AXIS
-
-        seq_parallel = self.mesh.shape[SEQUENCE_AXIS] > 1
-        self.model = Transformer(cfg.model, mesh=self.mesh if seq_parallel else None)
-        self.schedule = make_schedule(opt)
-        self.tx = make_optimizer(opt, self.schedule)
-
-        self.sample_shape = (cfg.training.batch_size, cfg.training.train_context)
-        self.plan = make_plan(
-            self.model, self.tx, self.mesh, self.sample_shape, self.zero_stage
-        )
-        self.train_step = make_train_step(
-            self.model,
-            self.tx,
-            self.mesh,
-            self.plan,
-            self.zero_stage,
-            self.schedule,
-            # lets the explicit ZeRO-2/3 core rebuild the optimizer with a
-            # shard-aware grad-clip norm (same opt-state structure)
-            tx_factory=lambda norm_fn, zc=None: make_optimizer(
-                opt, self.schedule, norm_fn, zero_collectives=zc
-            ),
-            pp_schedule=cfg.mesh.pp_schedule,
-            grad_accum_dtype=cfg.training.grad_accum_dtype,
-        )
-        self.eval_step = make_eval_step(self.model, self.mesh, self.plan)
+        self.model = build.model
+        self.schedule = build.schedule
+        self.tx = build.tx
+        self.sample_shape = build.sample_shape
+        self.plan = build.plan
+        self.train_step = build.train_step
+        self.eval_step = build.eval_step
         self.batch_sharding = NamedSharding(
             self.mesh, P(None, *self.plan.batch.spec)
         )
