@@ -1,0 +1,21 @@
+"""pod_check quick-lane coverage: single-process health + bandwidth micro.
+
+The cross-process legs live in the slow tier (tests/multihost_worker.py);
+these pin the value-checked psum and the bandwidth report shape on the
+8-device virtual mesh.
+"""
+from zero_transformer_tpu.utils.pod_check import allreduce_bandwidth, pod_check
+
+
+def test_pod_check_healthy(devices):
+    assert pod_check(timeout=120.0, verbose=False)
+
+
+def test_allreduce_bandwidth_report(devices):
+    r = allreduce_bandwidth(mib=1.0, reps=2, verbose=False)
+    assert r["devices"] == 8
+    assert r["buffer_mib_per_device"] == 1.0
+    assert r["algo_bandwidth_GBps"] > 0
+    # ring-transfer bytes are 2(n-1)/n of the buffer: 1.75x at n=8 (both
+    # values are rounded to 2 decimals in the report, hence the tolerance)
+    assert abs(r["ring_transfer_GBps"] / r["algo_bandwidth_GBps"] - 1.75) < 0.1
