@@ -81,6 +81,13 @@ class ModelConfig:
     # traffic and doubles servable context; dequant fuses into the attention
     # reads inside the decode loop. Training paths ignore this.
     kv_cache_dtype: str = "auto"
+    # Weight-only int8 for the INFERENCE path (serve --quantize int8):
+    # Dense kernels and the token table become int8 + per-output-channel /
+    # per-vocab-row f32 scales (models/quant.py); HBM weight reads halve —
+    # decode is bandwidth-bound, and this is what fits 8B-class models on
+    # one 16 GB chip. Training rejects it (build_training); loss paths
+    # raise.
+    param_quant: str = "none"  # "none" | "int8"
     # Packed-sequence training: rows hold multiple documents separated by
     # this token id. Attention is masked so documents cannot see each other
     # (segments derived in-graph from the separator — no loader changes) and
@@ -177,6 +184,13 @@ class ModelConfig:
             raise ValueError(f"invalid cp_impl {self.cp_impl!r}")
         if self.kv_cache_dtype not in ("auto", "int8"):
             raise ValueError(f"invalid kv_cache_dtype {self.kv_cache_dtype!r}")
+        if self.param_quant not in ("none", "int8"):
+            raise ValueError(f"invalid param_quant {self.param_quant!r}")
+        if self.param_quant != "none" and self.n_experts > 0:
+            raise ValueError(
+                "param_quant does not cover MoE expert tensors yet — "
+                "quantized serving is dense-model only"
+            )
         resolve_dtype(self.param_dtype)
         resolve_dtype(self.compute_dtype)
 

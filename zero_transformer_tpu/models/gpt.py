@@ -40,7 +40,16 @@ from zero_transformer_tpu.ops.positions import apply_rope
 Dtype = Any
 
 
-def _dense(features: int, axes: Tuple, std: float, dtype, param_dtype, name: str):
+def _dense(
+    features: int, axes: Tuple, std: float, dtype, param_dtype, name: str,
+    quant: bool = False,
+):
+    if quant:  # weight-only int8 inference path (models/quant.py)
+        from zero_transformer_tpu.models.quant import QuantDense
+
+        return QuantDense(
+            features=features, axes=axes, std=std, dtype=dtype, name=name
+        )
     return nn.Dense(
         features,
         use_bias=False,
@@ -163,10 +172,11 @@ class Attention(nn.Module):
         H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_width
         B, T, _ = x.shape
         resid_std = 0.02 / (2 * cfg.n_layers) ** 0.5
+        quant = cfg.param_quant == "int8"
 
-        q = _dense(H * D, ("embed", "qheads"), 0.02, dtype, param_dtype, "query")(x)
-        k = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "key")(x)
-        v = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "value")(x)
+        q = _dense(H * D, ("embed", "qheads"), 0.02, dtype, param_dtype, "query", quant)(x)
+        k = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "key", quant)(x)
+        v = _dense(KVH * D, ("embed", "kvheads"), 0.02, dtype, param_dtype, "value", quant)(x)
         q = constrain_activation(q.reshape(B, T, H, D), "batch", "seq", "heads", "head_dim")
         k = constrain_activation(k.reshape(B, T, KVH, D), "batch", "seq", "kvheads", "head_dim")
         v = constrain_activation(v.reshape(B, T, KVH, D), "batch", "seq", "kvheads", "head_dim")
@@ -258,7 +268,7 @@ class Attention(nn.Module):
             )
 
         out = out.reshape(B, T, H * D)
-        out = _dense(cfg.d_model, ("qheads", "embed"), resid_std, dtype, param_dtype, "out")(out)
+        out = _dense(cfg.d_model, ("qheads", "embed"), resid_std, dtype, param_dtype, "out", quant)(out)
         return nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
 
 
@@ -273,8 +283,9 @@ class MLP(nn.Module):
         param_dtype = resolve_dtype(cfg.param_dtype)
         resid_std = 0.02 / (2 * cfg.n_layers) ** 0.5
         f = cfg.ff_dim
+        quant = cfg.param_quant == "int8"
         h = constrain_activation(
-            _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "wi")(x),
+            _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "wi", quant)(x),
             "batch", "seq", "mlp",
         )
         # saved under remat_policy="qkv_mlp": wo's weight gradient needs
@@ -283,13 +294,13 @@ class MLP(nn.Module):
         h = checkpoint_name(h, "mlp_wi")
         if cfg.activation == "swiglu":
             g = checkpoint_name(
-                _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "gate")(x),
+                _dense(f, ("embed", "mlp"), 0.02, dtype, param_dtype, "gate", quant)(x),
                 "mlp_gate",
             )
             h = nn.silu(g) * h
         else:
             h = nn.gelu(h)
-        out = _dense(cfg.d_model, ("mlp", "embed"), resid_std, dtype, param_dtype, "wo")(h)
+        out = _dense(cfg.d_model, ("mlp", "embed"), resid_std, dtype, param_dtype, "wo", quant)(h)
         return nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
 
 
@@ -361,20 +372,43 @@ class Transformer(nn.Module):
         dtype = resolve_dtype(cfg.compute_dtype)
         param_dtype = resolve_dtype(cfg.param_dtype)
         B, T = x.shape
+        quant = cfg.param_quant == "int8"
 
-        embed = nn.Embed(
-            num_embeddings=cfg.vocab_size,
-            features=cfg.d_model,
-            embedding_init=nn.with_partitioning(
-                initializers.normal(stddev=0.02), ("vocab", "embed")
-            ),
-            dtype=dtype,
-            param_dtype=param_dtype,
-            name="wte",
-        )
-        if self.decode:
+        if quant:
+            # weight-only int8 (inference only — the trainer rejects it):
+            # int8 rows + per-row scales through both the lookup and the
+            # tied head's attend (models/quant.py)
+            if labels is not None:
+                raise NotImplementedError(
+                    "param_quant='int8' is an inference configuration; the "
+                    "loss paths (incl. chunked CE's direct kernel reads) "
+                    "run on full-precision params"
+                )
+            from zero_transformer_tpu.models.quant import QuantEmbed
+
+            embed = QuantEmbed(
+                num_embeddings=cfg.vocab_size,
+                features=cfg.d_model,
+                dtype=dtype,
+                name="wte",
+            )
+        else:
+            embed = nn.Embed(
+                num_embeddings=cfg.vocab_size,
+                features=cfg.d_model,
+                embedding_init=nn.with_partitioning(
+                    initializers.normal(stddev=0.02), ("vocab", "embed")
+                ),
+                dtype=dtype,
+                param_dtype=param_dtype,
+                name="wte",
+            )
+        if self.decode or quant:
             # decode gathers [B, <=few] ids per step; replicating the table
-            # inside the decode while_loop would all-gather it every token
+            # inside the decode while_loop would all-gather it every token.
+            # (The quant prefill/eval path also gathers directly: its table
+            # reads are int8, and quant serving meshes are pure-TP where
+            # the replicated-view rewrite below is not needed.)
             h = embed(x)
         else:
             # Token lookup runs on an explicitly REPLICATED view of the
@@ -452,11 +486,15 @@ class Transformer(nn.Module):
 
         h = _norm(cfg, h.dtype, "ln_f")(h)
 
-        head = (
-            None
-            if cfg.tie_embeddings
-            else LMHead(cfg.d_model, cfg.vocab_size, dtype, param_dtype, name="lm_head")
-        )
+        if cfg.tie_embeddings:
+            head = None
+        elif quant:
+            head = _dense(
+                cfg.vocab_size, ("embed", "vocab"), 0.02, dtype, param_dtype,
+                "lm_head", quant=True,
+            )
+        else:
+            head = LMHead(cfg.d_model, cfg.vocab_size, dtype, param_dtype, name="lm_head")
 
         if labels is not None and cfg.loss_chunk and not self.decode:
             # chunked CE: the [B, T, vocab] logits never materialize —

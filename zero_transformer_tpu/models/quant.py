@@ -1,0 +1,154 @@
+"""Weight-only int8 quantization for the inference path.
+
+TPU-native rationale: decode is HBM-bandwidth-bound (weight bytes stream
+per token), so halving the bytes ~doubles the decode ceiling — and it is
+what fits Llama-3-8B-class models on ONE 16 GB v5e chip. The kernel stays
+int8 in HBM and upcasts in-register inside the matmul fusion — the same
+fusion contract the int8 KV cache rides (measured faster than bf16 on
+chip, ``BENCHMARKS.md``); the f32 per-channel scale applies AFTER the
+matmul, which is exact for per-output-channel quantization:
+
+    x @ (q * s)  ==  (x @ q) * s          (s broadcast over columns)
+
+Capability extension of the reference's inference side-car
+(``torch_compatability/GPT2.py`` runs fp16 CUDA; no quantization exists
+anywhere in the reference). Serving surface: ``serve --quantize int8``.
+
+Layout contract (mirrors the bf16 modules 1:1 so sharding rules apply
+unchanged): ``kernel`` [*, in, out] -> ``kernel_q`` int8 same shape +
+``scale`` f32 [*, out]; ``wte/embedding`` [V, d] -> ``embedding_q`` int8 +
+``scale`` f32 [V] (per-row, exact through both the lookup and the tied
+``attend`` logits matmul).
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers
+
+
+def quantize_array(w: jax.Array, axis: int) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-channel int8: reduce |max| over ``axis``; returns
+    (q int8 with ``w``'s shape, scale f32 with ``axis`` removed)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.round(w / jnp.expand_dims(scale, axis)).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _int8_normal(std: float):
+    """Init for an untrained quantized kernel: int8 draws whose dequantized
+    distribution (with ``_q_scale(std)``) approximates normal(0, std)."""
+
+    def init(key, shape, dtype=jnp.int8):
+        return jnp.clip(
+            jnp.round(jax.random.normal(key, shape) * (127.0 / 3.0)),
+            -127, 127,
+        ).astype(dtype)
+
+    return init
+
+
+def _q_scale(std: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, std * 3.0 / 127.0, dtype)
+
+    return init
+
+
+class QuantDense(nn.Module):
+    """Bias-free Dense with an int8 kernel + f32 per-output-channel scale.
+
+    Same param path prefix, logical axes, and call contract as the
+    ``nn.Dense`` built by ``models/gpt.py::_dense``, so the sharding rules
+    and scan stacking apply unchanged."""
+
+    features: int
+    axes: Tuple
+    std: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        q = self.param(
+            "kernel_q",
+            nn.with_partitioning(_int8_normal(self.std), self.axes),
+            (x.shape[-1], self.features),
+            jnp.int8,
+        )
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(_q_scale(self.std), (self.axes[-1],)),
+            (self.features,),
+            jnp.float32,
+        )
+        # int8 HBM read; the astype upcast fuses into the dot
+        y = x.astype(self.dtype) @ jnp.asarray(q).astype(self.dtype)
+        return y * jnp.asarray(scale).astype(self.dtype)
+
+
+class QuantEmbed(nn.Module):
+    """Token table as int8 rows + f32 per-row scales; exact per-row dequant
+    through BOTH consumers: the lookup (gather rows, scale) and the tied
+    head's ``attend`` (matmul against the int8 table, scale the logits)."""
+
+    num_embeddings: int
+    features: int
+    dtype: Any
+
+    def setup(self):
+        self.embedding_q = self.param(
+            "embedding_q",
+            nn.with_partitioning(_int8_normal(0.02), ("vocab", "embed")),
+            (self.num_embeddings, self.features),
+            jnp.int8,
+        )
+        self.scale = self.param(
+            "scale",
+            nn.with_partitioning(_q_scale(0.02), ("vocab",)),
+            (self.num_embeddings,),
+            jnp.float32,
+        )
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        rows = jnp.take(jnp.asarray(self.embedding_q), ids, axis=0)
+        s = jnp.take(jnp.asarray(self.scale), ids, axis=0)
+        return rows.astype(self.dtype) * s[..., None].astype(self.dtype)
+
+    def attend(self, h: jax.Array) -> jax.Array:
+        logits = h.astype(self.dtype) @ jnp.asarray(self.embedding_q).T.astype(
+            self.dtype
+        )
+        return logits * jnp.asarray(self.scale).astype(self.dtype)
+
+
+def quantize_params(params: dict) -> dict:
+    """Trained bf16/f32 params -> the quantized model's param tree.
+
+    Walks the tree by leaf path: every ``kernel`` (2-D, or scan-stacked
+    [L, in, out]) becomes ``kernel_q`` + per-output-channel ``scale``;
+    ``wte``'s ``embedding`` becomes ``embedding_q`` + per-row ``scale``.
+    Norm scales, biases, and ``wpe`` stay full precision (tiny)."""
+
+    def convert(tree: dict, path: tuple) -> dict:
+        out: dict = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = convert(v, path + (k,))
+            elif k == "kernel" and getattr(v, "ndim", 0) >= 2:
+                q, scale = quantize_array(v, axis=-2)
+                out["kernel_q"] = q
+                out["scale"] = scale
+            elif k == "embedding" and path and path[-1] == "wte":
+                q, scale = quantize_array(v, axis=-1)
+                out["embedding_q"] = q
+                out["scale"] = scale
+            else:
+                out[k] = v
+        return out
+
+    return convert(params, ())

@@ -57,6 +57,11 @@ class TrainingBuild:
 
 
 def build_training(cfg: Config, mesh=None) -> TrainingBuild:
+    if cfg.model.param_quant != "none":
+        raise ValueError(
+            "param_quant is an inference-only configuration (serve "
+            "--quantize); training runs on full-precision params"
+        )
     mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
     opt = dataclasses.replace(cfg.optimizer, total_steps=cfg.training.total_steps)
     # an active sequence axis routes attention through the ring-attention

@@ -111,9 +111,20 @@ def allreduce_bandwidth(
             ring_transfer_GBps=round(ring_gbs, 2),
         )
 
-    worker = threading.Thread(target=run, daemon=True)
+    def guarded() -> None:
+        try:
+            run()
+        except Exception as e:  # reported distinctly from a timeout below
+            result["raised"] = e
+
+    worker = threading.Thread(target=guarded, daemon=True)
     worker.start()
     worker.join(timeout)
+    if "raised" in result:
+        msg = f"bandwidth measurement raised: {result['raised']!r}"
+        if verbose:
+            print(f"UNHEALTHY: {msg}")
+        return {"error": msg}
     if worker.is_alive() or "devices" not in result:
         msg = (
             f"timeout: {mib} MiB allreduce did not complete within "
@@ -184,11 +195,14 @@ def main(argv=None) -> None:
     p.add_argument("--bandwidth", type=float, default=0.0, metavar="MiB",
                    help="after the health check, time a MiB-per-device psum "
                         "and report achieved all-reduce bandwidth (the "
-                        "ICI-vs-DCN diagnosis for a slow pod run)")
+                        "ICI-vs-DCN diagnosis for a slow pod run); shares "
+                        "--timeout with the health leg")
     args = p.parse_args(argv)
     healthy = pod_check(args.timeout)
     if healthy and args.bandwidth > 0:
-        if "error" in allreduce_bandwidth(mib=args.bandwidth):
+        if "error" in allreduce_bandwidth(
+            mib=args.bandwidth, timeout=args.timeout
+        ):
             healthy = False  # wedged mid-transfer: exit through the same
             # hard-exit path (the daemon worker still holds the collective)
     if not healthy:
