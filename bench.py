@@ -231,9 +231,14 @@ def child_decode() -> dict:
 
     platform = jax.default_backend()
     print(f"devices_ok platform={platform}", file=sys.stderr)
+    # BENCH_DECODE_QUANT=int8: weight-only int8 serving path (random int8
+    # init — decode throughput is weight-bandwidth-bound, values don't
+    # matter). Paired with the bf16 row it measures what halving the weight
+    # reads buys.
+    quant = os.environ.get("BENCH_DECODE_QUANT", "none")
     cfg = model_config(
         model_name, dropout=0.0, param_dtype="bfloat16",
-        compute_dtype="bfloat16", kv_cache_dtype=kv_dtype,
+        compute_dtype="bfloat16", kv_cache_dtype=kv_dtype, param_quant=quant,
     )
     model = decode_model(cfg, prompt_len + new)
     prompt = jax.random.randint(
@@ -292,6 +297,7 @@ def child_decode() -> dict:
         "prompt_len": prompt_len,
         "new_tokens": new,
         "kv_cache_dtype": kv_dtype,
+        "param_quant": quant,
         "sampling": ("greedy" if sampling.greedy
                      else f"top_k={sampling.top_k}:{sampling.top_k_impl}"),
         "compile_seconds": round(t_compile, 1),
@@ -649,7 +655,19 @@ def main() -> None:
         )
         if not decode_int8.get("ok"):
             errors.append(_truncate(f"decode_int8: {decode_int8.get('error')}"))
-        return {"flash": flash, "decode": decode, "decode_int8": decode_int8}
+        # the fully bandwidth-optimized decode: int8 weights AND int8 KV —
+        # what `serve --quantize int8 --kv-cache-dtype int8` runs
+        decode_w8 = _run_child(
+            "decode",
+            {"BENCH_DECODE_QUANT": "int8", "BENCH_DECODE_KV": "int8",
+             "BENCH_DECODE_SPEC": "0"}, 600.0,
+        )
+        if not decode_w8.get("ok"):
+            errors.append(_truncate(f"decode_w8: {decode_w8.get('error')}"))
+        return {
+            "flash": flash, "decode": decode, "decode_int8": decode_int8,
+            "decode_w8": decode_w8,
+        }
 
     def run_block(scenarios, micros_at_first_tpu_ok=False) -> bool:
         """Run train scenarios in order; False = stop the ladder (tunnel
@@ -719,6 +737,7 @@ def main() -> None:
         flash, decode, decode_int8 = (
             micros["flash"], micros["decode"], micros["decode_int8"]
         )
+        decode_w8 = micros.get("decode_w8", {"ok": False, "error": "not run"})
         loader = _run_child("loader", {"BENCH_PLATFORM": "cpu"}, 300.0)
         if not loader.get("ok"):
             errors.append(_truncate(f"loader: {loader.get('error')}"))
@@ -734,6 +753,7 @@ def main() -> None:
                 "flash_microbench": flash,
                 "decode_microbench": decode,
                 "decode_int8_microbench": decode_int8,
+                "decode_w8_microbench": decode_w8,
                 "loader_microbench": loader,
                 "errors": errors,
             },
