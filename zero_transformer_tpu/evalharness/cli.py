@@ -64,6 +64,10 @@ def main(argv=None) -> None:
     p.add_argument("--num-bytes", type=int, default=None, help="UTF-8 bytes for bpb")
     p.add_argument("--limit", type=int, default=None, help="cap example count")
     p.add_argument("--tokenizer", default=None, help="HF tokenizer for text JSONL")
+    p.add_argument("--quantize", default="none", choices=("none", "int8"),
+                   help="score the weight-only int8 serving path (the same "
+                        "conversion serve --quantize runs) — measures what "
+                        "the quantization costs in eval quality")
     args = p.parse_args(argv)
 
     from zero_transformer_tpu.checkpoint import import_params_msgpack
@@ -75,8 +79,15 @@ def main(argv=None) -> None:
     )
     from zero_transformer_tpu.models import Transformer
 
-    cfg = model_config(args.model, compute_dtype=args.dtype, dropout=0.0)
+    cfg = model_config(
+        args.model, compute_dtype=args.dtype, dropout=0.0,
+        param_quant=args.quantize,
+    )
     params = import_params_msgpack(args.params)
+    if args.quantize == "int8":
+        from zero_transformer_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)  # host-side, before device placement
     model = Transformer(cfg)
     tokenizer = None
     if args.tokenizer:

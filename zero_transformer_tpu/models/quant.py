@@ -30,14 +30,20 @@ import jax.numpy as jnp
 from jax.nn import initializers
 
 
-def quantize_array(w: jax.Array, axis: int) -> Tuple[jax.Array, jax.Array]:
+def quantize_array(w, axis: int):
     """Symmetric per-channel int8: reduce |max| over ``axis``; returns
-    (q int8 with ``w``'s shape, scale f32 with ``axis`` removed)."""
-    w = jnp.asarray(w, jnp.float32)
-    amax = jnp.max(jnp.abs(w), axis=axis)
-    scale = jnp.maximum(amax / 127.0, 1e-12)
-    q = jnp.round(w / jnp.expand_dims(scale, axis)).astype(jnp.int8)
-    return q, scale.astype(jnp.float32)
+    (q int8 with ``w``'s shape, scale f32 with ``axis`` removed).
+
+    Deliberately numpy, NOT jnp: conversion must stay on the host so an
+    8B-class checkpoint is never materialized at full precision on the
+    device mid-conversion (serve/evalharness quantize before placement)."""
+    import numpy as np
+
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=axis)
+    scale = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
+    q = np.round(w / np.expand_dims(scale, axis)).astype(np.int8)
+    return q, scale
 
 
 def _int8_normal(std: float):
