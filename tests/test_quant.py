@@ -31,15 +31,21 @@ def _dequantized(params_q, params_ref):
         for k, v in rt.items():
             if isinstance(v, dict):
                 out[k] = walk(qt[k], v)
-            elif "kernel_q" in qt:
-                out["kernel"] = (
+            elif k == "embedding" and "embedding_q" in qt:
+                out[k] = (
+                    qt["embedding_q"].astype(np.float32)
+                    * np.expand_dims(qt["scale"], -1)
+                )
+            elif k == "kernel" and "kernel_q" in qt:
+                out[k] = (
                     qt["kernel_q"].astype(np.float32)
                     * np.expand_dims(qt["scale"], -2)
                 )
-            elif "embedding_q" in qt:
-                out["embedding"] = (
-                    qt["embedding_q"].astype(np.float32)
-                    * np.expand_dims(qt["scale"], -1)
+            elif f"{k}_q" in qt:  # MoE expert weights (wi/wo/gate)
+                scale = qt[f"{k}_scale"]
+                out[k] = (
+                    qt[f"{k}_q"].astype(np.float32)
+                    * np.expand_dims(scale, -2)
                 )
             else:
                 out[k] = v
@@ -111,11 +117,33 @@ def test_quant_tree_is_half_the_bytes():
     assert nbytes(params_q) < 0.30 * nbytes(params)
 
 
+def test_quant_moe_forward_matches_dequantized_full():
+    """MoE expert tensors quantize too: per-(expert, out-channel) scales
+    applied after each expert einsum must reproduce the dequantized-full
+    model (same associativity argument as QuantDense)."""
+    cfg = dataclasses.replace(
+        CFG, n_experts=2, moe_top_k=1, activation="swiglu",
+    )
+    qcfg = dataclasses.replace(cfg, param_quant="int8")
+    x = jnp.asarray([[1, 5, 9, 2, 7, 3, 4, 8]], jnp.int32)
+    params = nn.meta.unbox(Transformer(cfg).init(jax.random.PRNGKey(0), x)["params"])
+    params_q = quantize_params(jax.tree.map(np.asarray, params))
+    expect = nn.meta.unbox(jax.eval_shape(
+        lambda: Transformer(qcfg).init(jax.random.PRNGKey(0), x)
+    )["params"])
+    assert jax.tree.structure(jax.tree.map(lambda l: 0, params_q)) == \
+        jax.tree.structure(jax.tree.map(lambda l: 0, expect))
+
+    out_q = Transformer(qcfg).apply({"params": params_q}, x)
+    out_f = Transformer(cfg).apply({"params": _dequantized(params_q, params)}, x)
+    np.testing.assert_allclose(
+        np.asarray(out_q), np.asarray(out_f), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_quant_rejections():
     with pytest.raises(ValueError, match="param_quant"):
         ModelConfig(param_quant="int4")
-    with pytest.raises(ValueError, match="dense-model only"):
-        ModelConfig(param_quant="int8", n_experts=2)
     # loss paths are full-precision only
     qcfg = dataclasses.replace(CFG, param_quant="int8")
     x = jnp.asarray([[1, 2, 3, 4]], jnp.int32)

@@ -186,11 +186,6 @@ class ModelConfig:
             raise ValueError(f"invalid kv_cache_dtype {self.kv_cache_dtype!r}")
         if self.param_quant not in ("none", "int8"):
             raise ValueError(f"invalid param_quant {self.param_quant!r}")
-        if self.param_quant != "none" and self.n_experts > 0:
-            raise ValueError(
-                "param_quant does not cover MoE expert tensors yet — "
-                "quantized serving is dense-model only"
-            )
         resolve_dtype(self.param_dtype)
         resolve_dtype(self.compute_dtype)
 

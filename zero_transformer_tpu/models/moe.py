@@ -119,22 +119,52 @@ class MoEMLP(nn.Module):
             + jnp.float32(cfg.router_z_coef) * zloss
         )
 
-        # stacked expert weights; `expert` logical axis → EP mesh axis
-        wi = self.param(
-            "wi",
-            nn.with_partitioning(
-                initializers.normal(stddev=0.02), ("expert", "embed", "mlp")
-            ),
-            (E, d, f),
-            param_dtype,
+        # stacked expert weights; `expert` logical axis → EP mesh axis.
+        # param_quant="int8" (inference only): int8 expert tensors +
+        # per-(expert, out-channel) f32 scales, applied AFTER each einsum —
+        # exact for this quantization granularity, same contract as
+        # models/quant.py::QuantDense
+        quant = cfg.param_quant == "int8"
+
+        def expert_weight(name, shape, axes, std):
+            if quant:
+                from zero_transformer_tpu.models.quant import (
+                    _int8_normal,
+                    _q_scale,
+                )
+
+                q = self.param(
+                    f"{name}_q",
+                    nn.with_partitioning(_int8_normal(std), axes),
+                    shape,
+                    jnp.int8,
+                )
+                scale = self.param(
+                    f"{name}_scale",
+                    nn.with_partitioning(_q_scale(std), (axes[0], axes[-1])),
+                    (shape[0], shape[-1]),
+                    jnp.float32,
+                )
+                return q, scale
+            w = self.param(
+                name,
+                nn.with_partitioning(initializers.normal(stddev=std), axes),
+                shape,
+                param_dtype,
+            )
+            return w, None
+
+        def expert_einsum(lhs, w, scale, spec="ebcd,edf->ebcf"):
+            y = jnp.einsum(spec, lhs, w.astype(dtype))
+            if scale is not None:
+                y = y * scale[:, None, None, :].astype(dtype)
+            return y
+
+        wi, wi_scale = expert_weight(
+            "wi", (E, d, f), ("expert", "embed", "mlp"), 0.02
         )
-        wo = self.param(
-            "wo",
-            nn.with_partitioning(
-                initializers.normal(stddev=resid_std), ("expert", "mlp", "embed")
-            ),
-            (E, f, d),
-            param_dtype,
+        wo, wo_scale = expert_weight(
+            "wo", (E, f, d), ("expert", "mlp", "embed"), resid_std
         )
 
         # dispatch: [B,T,d] tokens -> [E,B,C,d] expert buffers (all-to-all
@@ -144,25 +174,16 @@ class MoEMLP(nn.Module):
         # resolve_remat_policy): saving the expert pre-activations skips the
         # dispatch + wi einsum recompute — the dominant MoE re-forward cost —
         # exactly as saving mlp_wi does in the dense MLP
-        h = checkpoint_name(
-            jnp.einsum("ebcd,edf->ebcf", xin, wi.astype(dtype)), "mlp_wi"
-        )
+        h = checkpoint_name(expert_einsum(xin, wi, wi_scale), "mlp_wi")
         if cfg.activation == "swiglu":
-            wg = self.param(
-                "gate",
-                nn.with_partitioning(
-                    initializers.normal(stddev=0.02), ("expert", "embed", "mlp")
-                ),
-                (E, d, f),
-                param_dtype,
+            wg, wg_scale = expert_weight(
+                "gate", (E, d, f), ("expert", "embed", "mlp"), 0.02
             )
-            g = checkpoint_name(
-                jnp.einsum("ebcd,edf->ebcf", xin, wg.astype(dtype)), "mlp_gate"
-            )
+            g = checkpoint_name(expert_einsum(xin, wg, wg_scale), "mlp_gate")
             h = nn.silu(g) * h
         else:
             h = nn.gelu(h)
-        out_e = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(dtype))
+        out_e = expert_einsum(h, wo, wo_scale, "ebcf,efd->ebcd")
         out = jnp.einsum("btec,ebcd->btd", combine.astype(dtype), out_e)
         out = nn.Dropout(cfg.dropout, deterministic=self.deterministic)(out)
         return out, aux

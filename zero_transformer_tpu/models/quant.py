@@ -18,7 +18,10 @@ Layout contract (mirrors the bf16 modules 1:1 so sharding rules apply
 unchanged): ``kernel`` [*, in, out] -> ``kernel_q`` int8 same shape +
 ``scale`` f32 [*, out]; ``wte/embedding`` [V, d] -> ``embedding_q`` int8 +
 ``scale`` f32 [V] (per-row, exact through both the lookup and the tied
-``attend`` logits matmul).
+``attend`` logits matmul); MoE expert tensors ``wi``/``wo``/``gate``
+[*, E, in, out] -> ``<name>_q`` int8 + ``<name>_scale`` f32 [*, E, out]
+(distinct keys — three weights share one module dict; models/moe.py
+applies the scale after each expert einsum).
 """
 from __future__ import annotations
 
@@ -137,8 +140,10 @@ def quantize_params(params: dict) -> dict:
 
     Walks the tree by leaf path: every ``kernel`` (2-D, or scan-stacked
     [L, in, out]) becomes ``kernel_q`` + per-output-channel ``scale``;
-    ``wte``'s ``embedding`` becomes ``embedding_q`` + per-row ``scale``.
-    Norm scales, biases, and ``wpe`` stay full precision (tiny)."""
+    ``wte``'s ``embedding`` becomes ``embedding_q`` + per-row ``scale``;
+    MoE expert tensors (``wi``/``wo``/``gate``, [*, E, in, out]) become
+    ``<name>_q`` + per-(expert, out-channel) ``<name>_scale``. Norm
+    scales, biases, the router, and ``wpe`` stay full precision (tiny)."""
 
     def convert(tree: dict, path: tuple) -> dict:
         out: dict = {}
@@ -153,6 +158,13 @@ def quantize_params(params: dict) -> dict:
                 q, scale = quantize_array(v, axis=-1)
                 out["embedding_q"] = q
                 out["scale"] = scale
+            elif k in ("wi", "wo", "gate") and getattr(v, "ndim", 0) >= 3:
+                # stacked MoE expert tensors ([L,] E, in, out): per-(expert,
+                # out-channel) scales under distinct keys (three weights
+                # share one module dict)
+                q, scale = quantize_array(v, axis=-2)
+                out[f"{k}_q"] = q
+                out[f"{k}_scale"] = scale
             else:
                 out[k] = v
         return out
