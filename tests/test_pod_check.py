@@ -8,11 +8,13 @@ from zero_transformer_tpu.utils.pod_check import allreduce_bandwidth, pod_check
 
 
 def test_pod_check_healthy(devices):
-    assert pod_check(timeout=120.0, verbose=False)
+    # generous timeout: the suite shares the box with other jobs, and a
+    # wall-clock guard must not convert CPU contention into a failure
+    assert pod_check(timeout=600.0, verbose=False)
 
 
 def test_allreduce_bandwidth_report(devices):
-    r = allreduce_bandwidth(mib=1.0, reps=2, verbose=False)
+    r = allreduce_bandwidth(mib=1.0, reps=2, verbose=False, timeout=600.0)
     assert r["devices"] == 8
     assert r["buffer_mib_per_device"] == 1.0
     assert r["algo_bandwidth_GBps"] > 0
