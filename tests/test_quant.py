@@ -206,3 +206,28 @@ def test_quant_speculative_composes():
                      SamplingConfig(greedy=True))
     spec = generate_speculative(model, params, piece, 8, draft_len=4)
     np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_export_quantize_cli_roundtrip(tmp_path):
+    """`export quantize` writes a serving msgpack; quantize_params is
+    idempotent on it (kernel_q/scale leaves match no conversion rule), so
+    serve --quantize accepts both raw and pre-quantized artifacts."""
+    from zero_transformer_tpu.checkpoint import (
+        export_params_msgpack,
+        import_params_msgpack,
+    )
+    from zero_transformer_tpu.export import main as export_main
+
+    x = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    params = nn.meta.unbox(Transformer(CFG).init(jax.random.PRNGKey(0), x)["params"])
+    src = tmp_path / "p.msgpack"
+    dst = tmp_path / "q.msgpack"
+    export_params_msgpack(jax.tree.map(np.asarray, params), src)
+    export_main(["quantize", "--params", str(src), "--out", str(dst)])
+    assert dst.stat().st_size < 0.35 * src.stat().st_size  # f32 -> int8+scales
+    q = import_params_msgpack(dst)
+    q2 = quantize_params(q)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        q, q2,
+    )

@@ -331,6 +331,20 @@ def _cmd_to_reference(args) -> None:
     print(f"wrote {n:,} params in reference layout -> {args.out}")
 
 
+def _cmd_quantize(args) -> None:
+    from zero_transformer_tpu.checkpoint import (
+        export_params_msgpack,
+        import_params_msgpack,
+    )
+    from zero_transformer_tpu.models.quant import quantize_params
+
+    params = import_params_msgpack(args.params)
+    out = export_params_msgpack(quantize_params(params), args.out)
+    before = Path(args.params).stat().st_size
+    after = Path(args.out).stat().st_size
+    print(f"quantized {before:,} -> {after:,} bytes ({after / before:.2f}x) -> {out}")
+
+
 def _cmd_inspect(args) -> None:
     from zero_transformer_tpu.checkpoint import import_params_msgpack
     from zero_transformer_tpu.utils.surgery import is_stacked, num_layers
@@ -375,6 +389,16 @@ def main(argv=None) -> None:
     ins = sub.add_parser("inspect", help="list tensors in a params msgpack")
     ins.add_argument("--params", required=True)
     ins.set_defaults(fn=_cmd_inspect)
+
+    qz = sub.add_parser(
+        "quantize",
+        help="params msgpack -> weight-only int8 serving msgpack (the "
+             "conversion serve/evalharness --quantize run, paid once; "
+             "~4x smaller artifact from f32, ~2x from bf16)",
+    )
+    qz.add_argument("--params", required=True)
+    qz.add_argument("--out", required=True)
+    qz.set_defaults(fn=_cmd_quantize)
 
     tr = sub.add_parser(
         "to-reference",
