@@ -84,10 +84,14 @@ def test_pp_untied_head_and_rope(devices):
     np.testing.assert_allclose(float(mp["loss"]), float(md["loss"]), rtol=2e-4)
 
 
-def test_pp_with_remat_matches_dp(devices):
+@pytest.mark.parametrize("policy", ["none", "qkv_mlp"])
+def test_pp_with_remat_matches_dp(devices, policy):
     # the pipeline stage must honor cfg.remat (review finding: it was
-    # silently ignored) and stay numerically identical
-    cfg = dataclasses.replace(CFG, remat=True)
+    # silently ignored) and stay numerically identical — including under
+    # the named-save policy, whose checkpoint_name sites sit inside the
+    # scanned stage body under the pipe-manual shard_map (r5: the shared
+    # resolve_remat_policy must not degrade to None here)
+    cfg = dataclasses.replace(CFG, remat=True, remat_policy=policy)
     mesh_pp, s_pp, step_pp = _setup(MeshConfig(pipe=2, data=4), model_cfg=cfg)
     mesh_dp, s_dp, step_dp = _setup(MeshConfig(), model_cfg=cfg)
     rng = jax.random.PRNGKey(5)
