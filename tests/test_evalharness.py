@@ -229,3 +229,41 @@ def test_perplexity_batch_size_invariance(model_and_params):
     a = perplexity(model, params, stream, seq_len=16, batch_size=2)
     b = perplexity(model, params, stream, seq_len=16, batch_size=5)
     np.testing.assert_allclose(a["nll"], b["nll"], rtol=1e-5)
+
+
+def test_eval_cli_quantize_close_to_full(tmp_path, capsys):
+    """--quantize int8 scores the weight-only serving path: same CLI, same
+    data, a ppl within a few percent of the full-precision run (per-channel
+    int8 is a mild perturbation, not a different model)."""
+    import json
+
+    import flax.linen as nn
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.evalharness import cli
+    from zero_transformer_tpu.models import Transformer
+
+    cfg = model_config("test", compute_dtype="float32", dropout=0.0)
+    params = nn.meta.unbox(
+        Transformer(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    params_path = tmp_path / "p.msgpack"
+    params_path.write_bytes(msgpack_serialize(jax.tree.map(np.asarray, params)))
+    rng = np.random.default_rng(1)
+    data = tmp_path / "stream.json"
+    data.write_text(json.dumps(
+        {"tokens": [int(t) for t in rng.integers(1, 60, 70)], "num_bytes": 300}
+    ))
+
+    results = {}
+    for q in ("none", "int8"):
+        cli.main([
+            "--model", "test", "--params", str(params_path), "--task", "bpb",
+            "--data", str(data), "--seq-len", "16", "--batch-size", "2",
+            "--dtype", "float32", "--quantize", q,
+        ])
+        results[q] = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert results["int8"]["bits_per_byte"] == pytest.approx(
+        results["none"]["bits_per_byte"], rel=0.05
+    )
