@@ -274,3 +274,27 @@ def test_quant_llama8b_fits_one_v5e_chip():
         jax.random.PRNGKey(0),
     ))
     assert nbytes(full_shapes["params"]) > 15e9
+
+
+def test_quant_llama_family_matches_dequantized_full():
+    """RoPE/GQA/RMSNorm/SwiGLU/untied (the Llama recipe) under int8: the
+    rotation applies to activations after the quantized q/k projections and
+    the untied head is a QuantDense, so the whole family must reproduce the
+    dequantized-full model like the GPT family does."""
+    cfg = model_config("llama3_test", dropout=0.0, compute_dtype="float32",
+                       param_dtype="float32")
+    qcfg = dataclasses.replace(cfg, param_quant="int8")
+    x = jnp.asarray([[1, 5, 9, 2, 7, 3, 4, 8]], jnp.int32)
+    params = nn.meta.unbox(Transformer(cfg).init(jax.random.PRNGKey(0), x)["params"])
+    params_q = quantize_params(jax.tree.map(np.asarray, params))
+    expect = nn.meta.unbox(jax.eval_shape(
+        lambda: Transformer(qcfg).init(jax.random.PRNGKey(0), x)
+    )["params"])
+    assert jax.tree.structure(jax.tree.map(lambda l: 0, params_q)) == \
+        jax.tree.structure(jax.tree.map(lambda l: 0, expect))
+
+    out_q = Transformer(qcfg).apply({"params": params_q}, x)
+    out_f = Transformer(cfg).apply({"params": _dequantized(params_q, params)}, x)
+    np.testing.assert_allclose(
+        np.asarray(out_q), np.asarray(out_f), rtol=2e-4, atol=2e-4
+    )
