@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full bench bench-watch e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -22,6 +22,13 @@ test-full:
 # One-line JSON benchmark artifact (driver contract).
 bench:
 	$(PY) bench.py
+
+# Continuous-batching serving bench: 8 concurrent clients against a 2-slot
+# engine on the CPU test model, every response verified byte-identical to
+# single-request generate(). Emits BENCH_serve.json (TTFT/ITL percentiles,
+# tokens/s, occupancy); schema pinned by tests/test_serve_bench.py.
+serve-bench:
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2
 
 # Retry the bench ladder until a live on-chip measurement lands, then promote
 # it to BENCH_measured.json (this image's TPU tunnel wedges for hours at a

@@ -14,17 +14,29 @@
 # Success is judged by the artifacts, not exit codes: sample.txt is written
 # LAST by e2e_quality.py, so eval.json + sample.txt means the whole
 # prepare→train→eval→serve chain completed.
+# Runs land under runs/ (scratch, gitignored) and are PROMOTED into the
+# git-tracked docs/e2e/ dirs only on success — a failed or interrupted cycle
+# must never delete the last committed good artifact.
 #
 # Usage: bash scripts/e2e_watch.sh [OUT_DIR] [CYCLES] [FULL_TIMEOUT_S]
 set -u
-OUT=${1:-docs/e2e/full_tpu}
+OUT=${1:-runs/e2e/full_tpu}
 CYCLES=${2:-60}
 TMO=${3:-2400}
-SMOKE_OUT=${SMOKE_OUT:-docs/e2e/smoke_tpu_live}
+SMOKE_OUT=${SMOKE_OUT:-runs/e2e/smoke_tpu_live}
+PUBLISH_FULL=${PUBLISH_FULL:-docs/e2e/full_tpu}
+PUBLISH_SMOKE=${PUBLISH_SMOKE:-docs/e2e/smoke_tpu_live}
 cd "$(dirname "$0")/.."
 mkdir -p runs
-# a stale artifact from a previous run must not count as this run's success
+# a stale artifact from a previous SCRATCH run must not count as this run's
+# success (the published docs/e2e/ copies are left untouched)
 rm -f "$OUT/eval.json" "$OUT/sample.txt" "$SMOKE_OUT/eval.json" "$SMOKE_OUT/sample.txt"
+publish() { # publish SRC_DIR DEST_DIR: copy a completed run's artifacts
+  # top-level files only: the run's scratch data/ and ckpt/ dirs stay in
+  # runs/ (they were gitignored even under docs/e2e/)
+  mkdir -p "$2" && find "$1" -maxdepth 1 -type f -exec cp {} "$2"/ \; && \
+    echo "[$(date +%H:%M:%S)] published $1 -> $2" | tee -a runs/e2e_watch.log
+}
 probe() {
   timeout -k 10 120 python - <<'EOF' >/dev/null 2>&1
 import jax
@@ -38,11 +50,15 @@ for i in $(seq 1 "$CYCLES"); do
       timeout -k 30 900 python scripts/e2e_quality.py --mode smoke --on-chip \
         --out "$SMOKE_OUT" > "runs/e2e_smoke_tpu_$i.log" 2>&1
       echo "[$(date +%H:%M:%S)] smoke-on-chip rc=$?" | tee -a runs/e2e_watch.log
+      if [ -f "$SMOKE_OUT/eval.json" ] && [ -f "$SMOKE_OUT/sample.txt" ]; then
+        publish "$SMOKE_OUT" "$PUBLISH_SMOKE"  # bank the smoke artifact now
+      fi
     fi
     timeout -k 30 "$TMO" python scripts/e2e_quality.py --mode full --out "$OUT" \
       > "runs/e2e_full_tpu_$i.log" 2>&1
     echo "[$(date +%H:%M:%S)] full rc=$? (runs/e2e_full_tpu_$i.log)" | tee -a runs/e2e_watch.log
     if [ -f "$OUT/eval.json" ] && [ -f "$OUT/sample.txt" ]; then
+      publish "$OUT" "$PUBLISH_FULL"
       echo "E2E DONE: $OUT" | tee -a runs/e2e_watch.log
       exit 0
     fi

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Load generator for the continuous-batching serving engine.
+
+Drives ``serving.ServingEngine`` directly (no HTTP hop — this measures the
+scheduler + fused decode step, not socket overhead) in either mode:
+
+- **closed-loop** (default): N concurrent clients, each submitting its next
+  request the moment the previous one finishes — the saturation measurement;
+- **open-loop**: requests arrive at a fixed ``--rate`` regardless of
+  completions — the latency-under-load measurement (closed-loop hides
+  queueing delay by self-throttling).
+
+Every request's token stream is checked byte-for-byte against single-request
+``generate()`` with the same seed (``--no-verify`` to skip): the engine's
+request-isolation invariant, measured under real contention. The run emits a
+``BENCH_serve.json`` artifact (one JSON doc, also printed as the final
+stdout line) with TTFT/ITL percentiles, tokens/s, and occupancy evidence.
+
+CPU-runnable end to end with the ``test`` zoo model and random-init params —
+the orchestration layer is what is being measured, so no checkpoint needed:
+
+    JAX_PLATFORMS=cpu python scripts/serve_loadgen.py --requests 8 --slots 2
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="test", help="model zoo name")
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed-loop client count (capped at --requests)")
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--rate", type=float, default=16.0,
+                   help="open-loop arrival rate, requests/s")
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--cache-len", type=int, default=None)
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="admission-queue depth (large: the loadgen measures "
+                        "latency under queueing, not reject behavior)")
+    p.add_argument("--seed", type=int, default=0, help="base request seed")
+    p.add_argument("--no-verify", action="store_true",
+                   help="skip the per-request generate() parity check")
+    p.add_argument("--out", default=str(REPO / "BENCH_serve.json"))
+    return p.parse_args(argv)
+
+
+def make_requests(args, vocab_size: int, cache_len: int):
+    """Deterministic request mix: varied prompt lengths so admissions cross
+    prefill buckets, seeds offset from --seed."""
+    rng = random.Random(1234)
+    max_prompt = max(2, min(8, cache_len - args.max_new_tokens))
+    out = []
+    for i in range(args.requests):
+        length = rng.randint(2, max_prompt)
+        prompt = [rng.randint(1, vocab_size - 1) for _ in range(length)]
+        out.append((prompt, args.seed + i))
+    return out
+
+
+def build(args):
+    import jax
+    import jax.numpy as jnp
+
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.inference.sampling import SamplingConfig
+    from zero_transformer_tpu.models import Transformer
+    from zero_transformer_tpu.serving import ServingEngine
+
+    cfg = model_config(args.model, dropout=0.0)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    sampling = SamplingConfig(temperature=0.9, top_k=20)
+    cache_len = args.cache_len or cfg.max_seq_len
+
+    def engine():
+        return ServingEngine(
+            cfg, params, n_slots=args.slots, cache_len=cache_len,
+            sampling=sampling, max_queue=args.max_queue,
+        )
+
+    return cfg, params, sampling, cache_len, engine
+
+
+def reference_outputs(cfg, params, sampling, cache_len, requests, max_new):
+    import jax
+    import jax.numpy as jnp
+
+    from zero_transformer_tpu.inference.generate import decode_model, generate
+
+    model = decode_model(cfg, cache_len)
+    refs = []
+    for prompt, seed in requests:
+        toks = generate(
+            model, params, jnp.asarray([prompt], jnp.int32), max_new,
+            jax.random.PRNGKey(seed), sampling,
+        )
+        refs.append(jax.device_get(toks)[0].tolist())
+    return refs
+
+
+def run_load(engine, requests, args):
+    """Submit + drain all requests; returns (handles, wall_seconds)."""
+    handles: list = [None] * len(requests)
+    stop = threading.Event()
+    scheduler = threading.Thread(target=engine.run, args=(stop,), daemon=True)
+    started = time.monotonic()
+    scheduler.start()
+    try:
+        if args.mode == "open":
+            interval = 1.0 / args.rate if args.rate > 0 else 0.0
+            for i, (prompt, seed) in enumerate(requests):
+                handles[i] = engine.submit(
+                    prompt, max_new_tokens=args.max_new_tokens, seed=seed
+                )
+                time.sleep(interval)
+            for h in handles:
+                h.result(timeout=600)
+        else:
+            nxt = iter(range(len(requests)))
+            lock = threading.Lock()
+
+            def client():
+                while True:
+                    with lock:
+                        i = next(nxt, None)
+                    if i is None:
+                        return
+                    prompt, seed = requests[i]
+                    handle = engine.submit(
+                        prompt, max_new_tokens=args.max_new_tokens, seed=seed
+                    )
+                    handles[i] = handle
+                    for _ in handle.stream(timeout=600):
+                        pass  # drain the SSE-style per-token stream
+
+            workers = [
+                threading.Thread(target=client, daemon=True)
+                for _ in range(min(args.concurrency, len(requests)))
+            ]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join(timeout=600)
+    finally:
+        stop.set()
+        scheduler.join(timeout=30)
+    return handles, time.monotonic() - started
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    # some images pre-import jax with a platform baked into jax.config,
+    # where the JAX_PLATFORMS env var alone is a silent no-op — re-assert
+    # it through the config so "CPU run" means CPU
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass  # backend already initialized (e.g. under pytest)
+    cfg, params, sampling, cache_len, make_engine = build(args)
+    requests = make_requests(args, cfg.vocab_size, cache_len)
+
+    refs = None
+    if not args.no_verify:
+        refs = reference_outputs(
+            cfg, params, sampling, cache_len, requests, args.max_new_tokens
+        )
+
+    # warmup engine: pay prefill-bucket + fused-step compiles outside the
+    # measured run (jit caches are shared across engines — the model and
+    # sampling statics compare structurally equal)
+    warm = make_engine()
+    for prompt, seed in requests[: min(len(requests), args.slots + 1)]:
+        warm.submit(prompt, max_new_tokens=args.max_new_tokens, seed=seed)
+    warm.run_until_idle()
+
+    engine = make_engine()
+    handles, wall = run_load(engine, requests, args)
+
+    dropped = sum(1 for h in handles if h is None or h.status != "done")
+    mismatches = 0
+    if refs is not None:
+        mismatches = sum(
+            1
+            for h, ref in zip(handles, refs)
+            if h is None or h.tokens != ref
+        )
+    tokens_out = sum(len(h.tokens) for h in handles if h is not None)
+    snap = engine.metrics_snapshot()
+
+    artifact = {
+        "metric": f"serve_tokens_per_sec_{args.model}",
+        "value": round(tokens_out / wall, 3),
+        "unit": "tokens/s",
+        "model": args.model,
+        "mode": args.mode,
+        "slots": args.slots,
+        "requests": args.requests,
+        "concurrency": min(args.concurrency, args.requests),
+        "max_new_tokens": args.max_new_tokens,
+        "wall_s": round(wall, 3),
+        "ttft_ms": {q: round(snap[f"ttft_ms_{q}"], 3) for q in ("p50", "p90", "p99")},
+        "itl_ms": {q: round(snap[f"itl_ms_{q}"], 3) for q in ("p50", "p90", "p99")},
+        "peak_occupancy": snap["peak_occupancy"],
+        "peak_queue_depth": snap["peak_queue_depth"],
+        "completed": snap["completed"],
+        "rejected": snap["rejected_queue_full"] + snap["rejected_invalid"],
+        "dropped": dropped,
+        "verified": refs is not None,
+        "mismatches": mismatches,
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    Path(args.out).write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    if dropped or mismatches:
+        raise SystemExit(
+            f"LOAD RUN FAILED: {dropped} dropped, {mismatches} garbled "
+            f"(vs generate() baseline) of {args.requests}"
+        )
+    return artifact
+
+
+if __name__ == "__main__":
+    main()

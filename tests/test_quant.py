@@ -298,3 +298,55 @@ def test_quant_llama_family_matches_dequantized_full():
     np.testing.assert_allclose(
         np.asarray(out_q), np.asarray(out_f), rtol=2e-4, atol=2e-4
     )
+
+
+def test_quantize_params_validates_against_quant_model():
+    """With cfg, quantize_params cross-checks its by-name conversion
+    against the quant model's eval_shape structure: a good conversion
+    passes, a mangled tree fails AT CONVERSION with the offending paths
+    named (the alternative was an opaque flax structure mismatch deep
+    inside apply — ADVICE round 5)."""
+    params = Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    params = nn.meta.unbox(params)
+    host = jax.tree.map(np.asarray, params)
+
+    # the honest conversion validates clean
+    quantize_params(host, CFG)
+
+    # a checkpoint with an unexpected leaf name sails through the by-name
+    # walk unconverted — validation must name the stray path
+    bad = dict(host)
+    bad["blocks"] = dict(bad["blocks"])
+    bad["blocks"]["stray_module"] = {"kernel_oddname": np.zeros((4, 4))}
+    with pytest.raises(ValueError, match="stray_module"):
+        quantize_params(bad, CFG)
+
+    # a missing subtree must also fail with the path, not inside apply
+    short = {k: v for k, v in host.items() if k != "ln_f"}
+    with pytest.raises(ValueError, match="ln_f"):
+        quantize_params(short, CFG)
+
+    # without cfg: legacy behavior, no validation
+    quantize_params(bad)
+
+
+def test_serve_rejects_prequantized_artifact_without_flag(tmp_path):
+    """Importing an already-int8 msgpack without --quantize int8 must fail
+    fast with the remedy in the message, not as a flax structure mismatch
+    (ADVICE round 5)."""
+    from flax.serialization import msgpack_serialize
+
+    from zero_transformer_tpu.serve import main
+
+    params = Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    q = quantize_params(jax.tree.map(np.asarray, nn.meta.unbox(params)))
+    path = tmp_path / "p_int8.msgpack"
+    path.write_bytes(msgpack_serialize(q))
+
+    with pytest.raises(SystemExit, match="already int8-quantized"):
+        main(["--model", "test", "--params", str(path),
+              "--prompt", "x", "--tokenizer", "bytes"])

@@ -1,0 +1,73 @@
+"""scripts/serve_loadgen.py: the BENCH_serve.json artifact contract.
+
+Same philosophy as test_bench_artifact.py for the training bench: the
+artifact is the driver-facing evidence of a load run, so its schema and its
+invariants (no drops, no garbling, occupancy actually reached the slot
+count) are pinned here — a real (small) load run on CPU with the ``test``
+zoo model, not a mocked one.
+"""
+import importlib.util
+import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+REQUIRED_KEYS = {
+    "metric", "value", "unit", "model", "mode", "slots", "requests",
+    "max_new_tokens", "wall_s", "ttft_ms", "itl_ms", "peak_occupancy",
+    "peak_queue_depth", "completed", "rejected", "dropped", "verified",
+    "mismatches", "measured_at_utc",
+}
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen", REPO / "scripts" / "serve_loadgen.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_artifact_schema_and_invariants(tmp_path):
+    loadgen = _load()
+    out = tmp_path / "BENCH_serve.json"
+    artifact = loadgen.main([
+        "--requests", "6", "--slots", "2", "--concurrency", "6",
+        "--max-new-tokens", "8", "--out", str(out),
+    ])
+
+    on_disk = json.loads(out.read_text())
+    assert on_disk == artifact  # stdout line and file artifact must agree
+
+    missing = REQUIRED_KEYS - set(artifact)
+    assert not missing, f"artifact missing keys: {sorted(missing)}"
+    assert artifact["metric"] == "serve_tokens_per_sec_test"
+    assert artifact["unit"] == "tokens/s"
+    assert artifact["value"] > 0
+
+    for block in ("ttft_ms", "itl_ms"):
+        assert set(artifact[block]) == {"p50", "p90", "p99"}
+        assert artifact[block]["p50"] <= artifact[block]["p99"]
+
+    # the load-run correctness invariants the acceptance bar names
+    assert artifact["completed"] == 6
+    assert artifact["dropped"] == 0
+    assert artifact["verified"] is True and artifact["mismatches"] == 0
+    # 6 concurrent clients against 2 slots must saturate the engine
+    assert artifact["peak_occupancy"] == 2
+    assert artifact["peak_queue_depth"] >= 1
+
+
+def test_loadgen_request_mix_is_deterministic():
+    """Two processes building the mix must agree (the parity check decodes
+    the reference from the same (prompt, seed) pairs)."""
+    loadgen = _load()
+    args = loadgen.parse_args(["--requests", "5"])
+    a = loadgen.make_requests(args, 256, 32)
+    b = loadgen.make_requests(args, 256, 32)
+    assert a == b
+    assert len(a) == 5
+    assert all(2 <= len(p) <= 8 for p, _ in a)
+    seeds = [s for _, s in a]
+    assert seeds == list(range(5))  # seed = base + index

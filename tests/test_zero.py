@@ -182,6 +182,11 @@ def test_bf16_policy_trains_with_f32_master(zero_stage):
 
 def _collective_lines(step, state, batch, rng):
     """Compiled-HLO lines per collective op kind."""
+    if tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5):
+        # jaxlib 0.4.x ABORTS (uncatchable SIGABRT — it takes the whole
+        # pytest process down) compiling the explicit shard_map core for
+        # HLO inspection; the numerics tests above still cover these stages
+        pytest.skip("jaxlib < 0.5 SIGABRTs on HLO compile of the shard_map core")
     txt = step.lower(state, batch, rng).compile().as_text()
     out = {}
     for name in ("reduce-scatter", "all-gather", "all-reduce"):

@@ -174,6 +174,14 @@ class CheckpointManager:
             raise FileNotFoundError(f"step {step} has no 'state' item in {step_dir}")
         target = {"params": abstract_params}
         ckptr = ocp.PyTreeCheckpointer()
+        # newer orbax spells subtree restore `partial_restore=True`; older
+        # releases use the documented `transforms={}` idiom for the same thing
+        import inspect
+
+        if "partial_restore" in inspect.signature(ocp.args.PyTreeRestore).parameters:
+            partial_kwargs = {"partial_restore": True}
+        else:
+            partial_kwargs = {"transforms": {}}
         try:
             out = ckptr.restore(
                 state_dir,
@@ -185,7 +193,7 @@ class CheckpointManager:
                         ),
                         target,
                     ),
-                    partial_restore=True,
+                    **partial_kwargs,
                 ),
             )
         finally:

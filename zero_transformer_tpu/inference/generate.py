@@ -53,13 +53,12 @@ def shard_for_inference(model: Transformer, params: Any, mesh) -> Any:
     materializes), so this works for BOTH fresh boxed trees and plain trees
     restored from a checkpoint / reference msgpack import. zero_stage=0:
     serving has no optimizer state to shard and no data axis."""
-    from jax.sharding import AbstractMesh
-
     from zero_transformer_tpu.parallel import sharding as shd
+    from zero_transformer_tpu.utils.jax_compat import clear_abstract_mesh
 
     # clear any ambient mesh for the abstract init (same hazard as
     # init_cache below: flax boxing would read logical names as mesh axes)
-    with jax.sharding.use_abstract_mesh(AbstractMesh((), ())):
+    with clear_abstract_mesh():
         abstract = jax.eval_shape(
             lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32)),
             jax.random.PRNGKey(0),
@@ -89,9 +88,9 @@ def init_cache(model: Transformer, batch: int, rng=None, mesh=None) -> Any:
     # params' LOGICAL axis names ('vocab', 'embed', ...) as mesh axes and
     # fail NamedSharding validation — the logical->mesh translation is this
     # repo's sharding module's job, not flax's
-    from jax.sharding import AbstractMesh
+    from zero_transformer_tpu.utils.jax_compat import clear_abstract_mesh
 
-    with jax.sharding.use_abstract_mesh(AbstractMesh((), ())):
+    with clear_abstract_mesh():
         shapes = jax.eval_shape(
             lambda r: model.init(r, jnp.zeros((batch, 1), jnp.int32)), rng
         )["cache"]
@@ -143,7 +142,9 @@ def _in_mesh(mesh, fn, *args, **kwargs):
     """Call ``fn`` under ``jax.set_mesh(mesh)`` (no-op when mesh is None)."""
     if mesh is None:
         return fn(*args, **kwargs)
-    with jax.set_mesh(mesh):
+    from zero_transformer_tpu.utils.jax_compat import set_mesh
+
+    with set_mesh(mesh):
         return fn(*args, **kwargs)
 
 
@@ -201,7 +202,9 @@ def generate(
         )
 
     if mesh is not None:
-        with jax.set_mesh(mesh):
+        from zero_transformer_tpu.utils.jax_compat import set_mesh
+
+        with set_mesh(mesh):
             return run()
     return run()
 

@@ -209,19 +209,45 @@ class Attention(nn.Module):
             if use_cache:
                 offset = idx.value
 
+        # A [B]-vector cache_index (installed by serving.slots for the
+        # continuous-batching engine) means every row sits at its OWN
+        # position: writes, masks, and position-dependent biases all go
+        # per-row. The scalar path is untouched — a fresh init_cache gives
+        # scalar indices and generate()/prefill() keep compiling the same
+        # programs.
+        per_slot = getattr(offset, "ndim", 0) == 1
+
         if cfg.position == "rope":
-            pos = offset + jnp.arange(T, dtype=jnp.int32)
+            if per_slot:
+                pos = offset[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+            else:
+                pos = offset + jnp.arange(T, dtype=jnp.int32)
             q = apply_rope(q, pos, cfg.rope_theta)
             k = apply_rope(k, pos, cfg.rope_theta)  # cache stores rotated keys
 
         if use_cache:
+            if per_slot:
+                # per-row dynamic_update_slice at each slot's own offset
+                def write(buf, upd):
+                    return jax.vmap(
+                        lambda c, u, o: jax.lax.dynamic_update_slice(
+                            c, u, (o,) + (0,) * (c.ndim - 1)
+                        )
+                    )(buf, upd, offset)
+
+            else:
+                def write(buf, upd):
+                    return jax.lax.dynamic_update_slice(
+                        buf, upd, (0, offset) + (0,) * (buf.ndim - 2)
+                    )
+
             if int8_cache:
                 kq, k_scale = _quantize_kv(k)
                 vq, v_scale = _quantize_kv(v)
-                ck.value = jax.lax.dynamic_update_slice(ck.value, kq, (0, offset, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, vq, (0, offset, 0, 0))
-                ksc.value = jax.lax.dynamic_update_slice(ksc.value, k_scale, (0, offset, 0, 0))
-                vsc.value = jax.lax.dynamic_update_slice(vsc.value, v_scale, (0, offset, 0, 0))
+                ck.value = write(ck.value, kq)
+                cv.value = write(cv.value, vq)
+                ksc.value = write(ksc.value, k_scale)
+                vsc.value = write(vsc.value, v_scale)
                 # dequant fuses into the attention reads; the cache is a
                 # loop carry of the decode while_loop, so XLA cannot hoist
                 # this out — HBM traffic stays at int8 + one f32 scale per
@@ -231,16 +257,28 @@ class Attention(nn.Module):
                 k_all = (ck.value.astype(jnp.float32) * ksc.value).astype(dtype)
                 v_all = (cv.value.astype(jnp.float32) * vsc.value).astype(dtype)
             else:
-                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+                ck.value = write(ck.value, k)
+                cv.value = write(cv.value, v)
                 k_all, v_all = ck.value, cv.value
             idx.value = offset + T
-            kv_valid = (jnp.arange(ck.value.shape[1]) < offset + T).astype(jnp.int32)
+            max_len_b = ck.value.shape[1]
+            if per_slot:
+                kv_valid = (
+                    jnp.arange(max_len_b)[None, :] < (offset[:, None] + T)
+                ).astype(jnp.int32)
+            else:
+                kv_valid = jnp.broadcast_to(
+                    (jnp.arange(max_len_b) < offset + T).astype(jnp.int32)[None, :],
+                    (B, max_len_b),
+                )
             # Writing past capacity would silently clamp onto the last slot
             # (dynamic_update_slice semantics). Poison the output with NaN
             # instead so overflow is loud even under jit; generate() also
-            # guards statically.
-            overflow = offset + T > ck.value.shape[1]
+            # guards statically. Per-slot, only the overflowing ROW is
+            # poisoned — a parked slot must not corrupt its neighbors.
+            overflow = offset + T > max_len_b
+            if per_slot:
+                overflow = overflow[:, None, None, None]
             q = jnp.where(overflow, jnp.nan, 1.0).astype(q.dtype) * q
             out = xla_attention(
                 q,
@@ -249,7 +287,7 @@ class Attention(nn.Module):
                 causal=T > 1,
                 alibi=cfg.position == "alibi",
                 q_offset=offset,
-                segment_ids=jnp.broadcast_to(kv_valid[None, :], (B, ck.value.shape[1])),
+                segment_ids=kv_valid,
             )
         elif self.mesh is not None:
             if cfg.cp_impl == "ulysses":
@@ -448,7 +486,11 @@ class Transformer(nn.Module):
                 if not is_init:
                     offset = pos_var.value
                     pos_var.value = offset + T
-            positions = offset + jnp.arange(T, dtype=jnp.int32)
+            if getattr(offset, "ndim", 0) == 1:
+                # [B]-vector decode positions (continuous-batching slots)
+                positions = offset[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+            else:
+                positions = offset + jnp.arange(T, dtype=jnp.int32)
             h = h + wpe(positions)
 
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)

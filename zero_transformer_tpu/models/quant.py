@@ -135,7 +135,69 @@ class QuantEmbed(nn.Module):
         return logits * jnp.asarray(self.scale).astype(self.dtype)
 
 
-def quantize_params(params: dict) -> dict:
+def _tree_paths(tree: dict, prefix: tuple = ()) -> dict:
+    """Flatten a param tree to {('a','b','kernel'): shape}. Unwraps flax
+    Partitioned boxes (``.value``) so boxed and plain trees compare equal."""
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out.update(_tree_paths(v, prefix + (k,)))
+        else:
+            leaf = getattr(v, "value", v)
+            out[prefix + (k,)] = tuple(getattr(leaf, "shape", ()))
+    return out
+
+
+def validate_quantized_tree(converted: dict, cfg) -> None:
+    """Check a converted tree against the quant model's ``eval_shape``
+    param structure; raise with the exact path diff on mismatch.
+
+    A by-name conversion (``quantize_params`` walks leaf names) silently
+    produces a tree the quant model cannot consume when a checkpoint uses
+    unexpected names — flax then fails deep inside ``apply`` with an opaque
+    structure error. Failing AT CONVERSION names the offending paths."""
+    import dataclasses as _dc
+
+    import jax as _jax
+
+    from zero_transformer_tpu.models.gpt import Transformer
+
+    qcfg = _dc.replace(cfg, param_quant="int8")
+    model = Transformer(qcfg)
+    expected = _jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, 1), jnp.int32)),
+        _jax.random.PRNGKey(0),
+    )["params"]
+    want, got = _tree_paths(expected), _tree_paths(converted)
+    missing = sorted(set(want) - set(got))
+    unexpected = sorted(set(got) - set(want))
+    shapes = sorted(
+        p for p in set(want) & set(got) if want[p] != got[p]
+    )
+    if missing or unexpected or shapes:
+        fmt = lambda ps: ", ".join("/".join(p) for p in ps[:6]) + (
+            " …" if len(ps) > 6 else ""
+        )
+        parts = []
+        if missing:
+            parts.append(f"missing from conversion: {fmt(missing)}")
+        if unexpected:
+            parts.append(f"unexpected after conversion: {fmt(unexpected)}")
+        if shapes:
+            parts.append(
+                "shape mismatch: "
+                + ", ".join(
+                    f"/{'/'.join(p)} {got[p]} != {want[p]}" for p in shapes[:4]
+                )
+            )
+        raise ValueError(
+            f"quantize_params produced a tree the int8 {cfg.name!r} model "
+            "cannot consume — the checkpoint's leaf names/shapes do not "
+            "match the conversion's by-name walk. " + "; ".join(parts)
+        )
+
+
+def quantize_params(params: dict, cfg=None) -> dict:
     """Trained bf16/f32 params -> the quantized model's param tree.
 
     Walks the tree by leaf path: every ``kernel`` (2-D, or scan-stacked
@@ -143,7 +205,12 @@ def quantize_params(params: dict) -> dict:
     ``wte``'s ``embedding`` becomes ``embedding_q`` + per-row ``scale``;
     MoE expert tensors (``wi``/``wo``/``gate``, [*, E, in, out]) become
     ``<name>_q`` + per-(expert, out-channel) ``<name>_scale``. Norm
-    scales, biases, the router, and ``wpe`` stay full precision (tiny)."""
+    scales, biases, the router, and ``wpe`` stay full precision (tiny).
+
+    With ``cfg``, the converted tree is validated against the quant model's
+    ``eval_shape`` structure so a by-name mis-quantization fails HERE with
+    the offending paths, not as an opaque flax mismatch inside ``apply``
+    (an already-quantized tree passes through unchanged and validates)."""
 
     def convert(tree: dict, path: tuple) -> dict:
         out: dict = {}
@@ -169,4 +236,7 @@ def quantize_params(params: dict) -> dict:
                 out[k] = v
         return out
 
-    return convert(params, ())
+    converted = convert(params, ())
+    if cfg is not None:
+        validate_quantized_tree(converted, cfg)
+    return converted

@@ -16,7 +16,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from zero_transformer_tpu.ops.positions import NEG_INF, alibi_bias, causal_mask_bias
+from zero_transformer_tpu.ops.positions import (
+    NEG_INF,
+    alibi_bias,
+    alibi_slopes,
+    causal_mask_bias,
+)
 
 
 def xla_attention(
@@ -38,7 +43,9 @@ def xla_attention(
       q: [B, Tq, H, D]
       k, v: [B, Tkv, KVH, D]; KVH must divide H (GQA).
       q_offset: position of q[0] within the full sequence (decode w/ KV cache).
-        May be a traced scalar.
+        May be a traced scalar, or a traced [B] vector when every batch row
+        sits at its own position (continuous-batching decode: one fused step
+        over slots whose sequences have different lengths).
       slopes: optional [H] or [H, 1] f32 ALiBi slope override — for
         head-sharded callers (ulysses / TP local attention) whose local head
         0 is not global head 0.
@@ -57,7 +64,25 @@ def xla_attention(
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
     scores = scores * jnp.float32(scale)
 
-    if alibi:
+    per_row = getattr(q_offset, "ndim", 0) == 1
+    if per_row:
+        # per-row q positions: biases get a leading batch dim
+        q_pos = q_offset[:, None] + jnp.arange(Tq, dtype=jnp.int32)[None, :]  # [B, Tq]
+        kv_pos = jnp.arange(Tkv, dtype=jnp.int32)
+        if alibi:
+            s = (alibi_slopes(H) if slopes is None else slopes).reshape(H)
+            dist = jnp.maximum(
+                q_pos[:, :, None] - kv_pos[None, None, :], 0
+            ).astype(jnp.float32)  # [B, Tq, Tkv]
+            bias = -s[None, :, None, None] * dist[:, None]  # [B, H, Tq, Tkv]
+            if causal:
+                visible = kv_pos[None, None, :] <= q_pos[:, :, None]
+                bias = bias + jnp.where(visible, 0.0, NEG_INF)[:, None]
+            scores = scores + bias.reshape(B, KVH, G, Tq, Tkv)
+        elif causal:
+            visible = kv_pos[None, None, :] <= q_pos[:, :, None]  # [B, Tq, Tkv]
+            scores = scores + jnp.where(visible, 0.0, NEG_INF)[:, None, None]
+    elif alibi:
         bias = alibi_bias(H, Tq, Tkv, offset=q_offset, slopes=slopes)  # [H, Tq, Tkv]
         if causal:
             bias = bias + causal_mask_bias(Tq, Tkv, offset=q_offset)[None]

@@ -227,7 +227,9 @@ def constrain_activation(x: jax.Array, *names: Optional[str]) -> jax.Array:
     This is the Megatron "other half": without activation constraints GSPMD
     alone chooses TP activation layouts (round-3 VERDICT weak #3).
     """
-    amesh = jax.sharding.get_abstract_mesh()
+    from zero_transformer_tpu.utils.jax_compat import get_abstract_mesh
+
+    amesh = get_abstract_mesh()
     if amesh is None or not amesh.axis_names:
         return x
     auto = {
@@ -259,7 +261,9 @@ def replicate_activation(x: jax.Array) -> jax.Array:
     where one up-front all-gather beats the involuntary full
     rematerialization GSPMD otherwise inserts on the gather output. No-op
     without an ambient mesh."""
-    amesh = jax.sharding.get_abstract_mesh()
+    from zero_transformer_tpu.utils.jax_compat import get_abstract_mesh
+
+    amesh = get_abstract_mesh()
     if amesh is None or not amesh.axis_names:
         return x
     return jax.lax.with_sharding_constraint(x, P(*(None,) * x.ndim))

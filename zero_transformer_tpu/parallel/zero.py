@@ -33,7 +33,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from zero_transformer_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zero_transformer_tpu.parallel import sharding as shd
@@ -142,13 +142,15 @@ def _with_ambient_mesh(jitted, mesh: Mesh):
     ``.lower`` is preserved because the HLO regression tests use it."""
     import functools
 
+    from zero_transformer_tpu.utils.jax_compat import set_mesh
+
     @functools.wraps(jitted)
     def call(*args, **kwargs):
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             return jitted(*args, **kwargs)
 
     def lower(*args, **kwargs):
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             return jitted.lower(*args, **kwargs)
 
     call.lower = lower

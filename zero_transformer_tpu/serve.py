@@ -9,6 +9,8 @@ at :69-94) with the in-tree jitted decode path. Runs as:
 
 - with ``--prompt``: one-shot generation to stdout;
 - without: an interactive REPL;
+- with ``--server``: the continuous-batching HTTP server (slot-based KV
+  cache + request scheduler + SSE streaming — ``zero_transformer_tpu.serving``);
 - with ``--ui``: the same controls in a Gradio web UI when gradio is
   importable (it is not baked into this image — the CLI is the primary
   surface; the reference made the UI the only surface).
@@ -93,14 +95,12 @@ class TextGenerator:
         self.speculative = speculative
 
     def _decode(self, toks) -> str:
-        """Detokenize WITHOUT clean_up_tokenization_spaces: the cleanup pass
-        rewrites across token boundaries (" n" + "'t" -> "n't"), so a chunked
-        streaming decode would diverge from the whole-sequence decode unless
-        both paths pin it off. Falls back for tokenizers without the kwarg."""
-        try:
-            return self.tokenizer.decode(toks, clean_up_tokenization_spaces=False)
-        except TypeError:
-            return self.tokenizer.decode(toks)
+        """Detokenize through the shared pinned decode (no
+        clean_up_tokenization_spaces) so the one-shot path, the REPL stream,
+        and the SSE server can never diverge on detok behavior."""
+        from zero_transformer_tpu.serving.detok import decode_tokens
+
+        return decode_tokens(self.tokenizer, toks)
 
     def __call__(
         self,
@@ -190,15 +190,19 @@ class TextGenerator:
         UI's streaming behavior, ``app.py:42-94``, on the jitted step)."""
         from zero_transformer_tpu.inference import stream_tokens
 
+        from zero_transformer_tpu.serving.detok import StreamDecoder
+
         ids, sampling, eos = self._prepare(
             prompt, max_new_tokens, temperature, top_k, top_p,
             repetition_penalty, greedy,
         )
-        # committed-prefix decoding (HF TextStreamer pattern): only the
-        # UNCOMMITTED tail is re-decoded each step — O(n) total, not O(n^2)
-        # — and output is held back while the tail is an incomplete byte
-        # sequence (byte-level BPE chars can span tokens; decode -> U+FFFD)
-        pending: list = []
+        # committed-prefix decoding via the shared StreamDecoder (HF
+        # TextStreamer pattern): only the UNCOMMITTED tail is re-decoded
+        # each step — O(n) total, not O(n^2) — and output is held back while
+        # the tail is an incomplete byte sequence (byte-level BPE chars can
+        # span tokens; decode -> U+FFFD). One implementation with the SSE
+        # server's stream path, so the two surfaces cannot diverge.
+        decoder = StreamDecoder(self.tokenizer)
         for token in stream_tokens(
             self.model, self.params, jnp.asarray([ids], jnp.int32),
             max_new_tokens, jax.random.PRNGKey(seed), sampling,
@@ -207,14 +211,23 @@ class TextGenerator:
             t = int(token[0])
             if eos is not None and t == eos:
                 break
-            pending.append(t)
-            text = self._decode(pending)
-            if text.endswith("�"):
-                continue
-            yield text
-            pending = []
-        if pending:  # flush a genuinely incomplete tail at stream end
-            yield self._decode(pending)
+            piece = decoder.push(t)
+            if piece is not None:
+                yield piece
+        tail = decoder.flush()  # a genuinely incomplete tail at stream end
+        if tail is not None:
+            yield tail
+
+
+def _has_quantized_leaves(tree) -> bool:
+    """True when the tree already carries int8-serving leaves
+    (``kernel_q``/``embedding_q`` — the layout ``models/quant.py`` emits)."""
+    if not isinstance(tree, dict):
+        return False
+    return any(
+        k in ("kernel_q", "embedding_q") or _has_quantized_leaves(v)
+        for k, v in tree.items()
+    )
 
 
 def _build_generator(args) -> TextGenerator:
@@ -226,13 +239,22 @@ def _build_generator(args) -> TextGenerator:
         kv_cache_dtype=args.kv_cache_dtype, param_quant=args.quantize,
     )
     params = import_params_msgpack(args.params)
+    if args.quantize != "int8" and _has_quantized_leaves(params):
+        # caught here, at import time: letting this through used to surface
+        # as an opaque flax param-structure mismatch deep in apply()
+        raise SystemExit(
+            f"{args.params} is already int8-quantized (kernel_q/embedding_q "
+            "leaves found); pass --quantize int8 to serve it"
+        )
     if args.quantize == "int8":
         from zero_transformer_tpu.models.quant import quantize_params
 
         # quantize on HOST numpy first: deviceing the full-precision tree
         # before shrinking it would put the ~2x bytes on the chip at peak —
         # the exact OOM the flag exists to avoid on 8B-class models
-        params = quantize_params(params)
+        # (a pre-quantized artifact passes through unchanged and is
+        # validated against the quant model's structure)
+        params = quantize_params(params, cfg)
     params = jax.tree.map(jnp.asarray, params)
     tokenizer = _load_tokenizer(args.tokenizer)
     return TextGenerator(
@@ -240,6 +262,35 @@ def _build_generator(args) -> TextGenerator:
         speculative=args.speculative, tensor=args.tensor,
         top_k_impl="approx" if args.approx_top_k else "exact",
     )
+
+
+def _server(gen: TextGenerator, args) -> None:
+    """Continuous-batching server mode: N KV-cache slots, bounded admission
+    queue, SSE token streaming (POST /generate, GET /healthz, GET /metrics).
+    Sampling controls come from the CLI and are ENGINE-level (baked into the
+    fused decode step); requests vary prompt/budget/seed/deadline."""
+    from zero_transformer_tpu.inference import SamplingConfig
+    from zero_transformer_tpu.serving import ServingEngine, run_server
+    from zero_transformer_tpu.utils.monitoring import MetricsLogger
+
+    sampling = SamplingConfig(
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        repetition_penalty=args.repetition_penalty, greedy=args.greedy,
+        top_k_impl=gen.top_k_impl,
+    )
+    engine = ServingEngine(
+        gen.cfg,
+        gen.params,
+        n_slots=args.slots,
+        cache_len=gen.cache_len,
+        sampling=sampling,
+        eos_token_id=gen.tokenizer.eos_token_id,
+        max_queue=args.max_queue,
+        mesh=gen.mesh,
+        metrics=MetricsLogger(directory=args.metrics_dir),
+        metrics_interval=args.metrics_interval,
+    )
+    run_server(engine, gen.tokenizer, host=args.host, port=args.port)
 
 
 def _repl(gen: TextGenerator, args) -> None:
@@ -335,10 +386,29 @@ def main(argv=None) -> None:
     p.add_argument("--repetition-penalty", type=float, default=1.1)
     p.add_argument("--greedy", action="store_true")
     p.add_argument("--ui", action="store_true", help="launch the Gradio UI")
+    p.add_argument("--server", action="store_true",
+                   help="continuous-batching HTTP server: slot-based KV "
+                        "cache, bounded admission queue, SSE streaming "
+                        "(POST /generate, GET /healthz, GET /metrics)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--slots", type=int, default=4,
+                   help="concurrent decode slots (KV-cache rows); queued "
+                        "requests admit as slots free up")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission-queue depth; beyond it /generate "
+                        "returns 429 (backpressure)")
+    p.add_argument("--metrics-dir", default=None,
+                   help="JSONL sink for serving metrics (TTFT/ITL "
+                        "percentiles, tokens/s, occupancy)")
+    p.add_argument("--metrics-interval", type=int, default=200,
+                   help="log serving metrics every N scheduler ticks")
     args = p.parse_args(argv)
 
     gen = _build_generator(args)
-    if args.ui:
+    if args.server:
+        _server(gen, args)
+    elif args.ui:
         _ui(gen)
     elif args.prompt is not None:
         sys.stdout.write(

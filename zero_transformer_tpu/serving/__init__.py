@@ -1,0 +1,40 @@
+"""Continuous-batching serving engine.
+
+The orchestration layer above the jitted decode path: a slot-based KV cache
+(``slots``), a request scheduler with deadlines/cancellation/backpressure
+(``engine``), a streaming SSE front end (``server``), and the shared
+incremental detokenizer (``detok``). See docs/DESIGN.md § Serving engine.
+"""
+from zero_transformer_tpu.serving.detok import StreamDecoder
+from zero_transformer_tpu.serving.engine import (
+    CANCELLED,
+    DONE,
+    EXPIRED,
+    FAILED,
+    QUEUED,
+    REJECTED,
+    RUNNING,
+    Request,
+    RequestHandle,
+    ServingEngine,
+)
+from zero_transformer_tpu.serving.server import ServingServer, run_server
+from zero_transformer_tpu.serving.slots import SlotKVCache, vectorize_index
+
+__all__ = [
+    "CANCELLED",
+    "DONE",
+    "EXPIRED",
+    "FAILED",
+    "QUEUED",
+    "REJECTED",
+    "RUNNING",
+    "Request",
+    "RequestHandle",
+    "ServingEngine",
+    "ServingServer",
+    "SlotKVCache",
+    "StreamDecoder",
+    "run_server",
+    "vectorize_index",
+]

@@ -1,0 +1,62 @@
+"""Committed-prefix incremental detokenization.
+
+Streaming text out of a byte-level BPE safely needs two guarantees the naive
+``decode(all_tokens_so_far)`` loop does not give:
+
+- O(n) total work: only the UNCOMMITTED tail is re-decoded each step (the
+  HF ``TextStreamer`` pattern), not the whole sequence per token;
+- no replacement chars mid-stream: a character whose bytes span tokens
+  decodes to U+FFFD until complete, so output is held back while the tail is
+  an incomplete byte sequence, and the concatenation of emitted pieces is
+  byte-identical to the one-shot decode.
+
+Extracted from ``serve.TextGenerator.stream`` so the SSE server and the REPL
+stream through ONE implementation (the two surfaces must never diverge on
+detok behavior).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def decode_tokens(tokenizer, toks) -> str:
+    """Detokenize WITHOUT clean_up_tokenization_spaces: the cleanup pass
+    rewrites across token boundaries (" n" + "'t" -> "n't"), so a chunked
+    streaming decode would diverge from the whole-sequence decode unless
+    both paths pin it off. Falls back for tokenizers without the kwarg.
+    Stateless — the one pinned decode for every surface (one-shot, REPL
+    stream, SSE server)."""
+    try:
+        return tokenizer.decode(toks, clean_up_tokenization_spaces=False)
+    except TypeError:
+        return tokenizer.decode(toks)
+
+
+class StreamDecoder:
+    """Feed token ids, get decoded text increments."""
+
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        self._pending: List[int] = []
+
+    def decode(self, toks) -> str:
+        return decode_tokens(self.tokenizer, toks)
+
+    def push(self, token: int) -> Optional[str]:
+        """Add one token; returns the next committed text piece, or None
+        while the tail is an incomplete multi-byte character."""
+        self._pending.append(token)
+        text = self.decode(self._pending)
+        if text.endswith("�"):
+            return None
+        self._pending = []
+        return text
+
+    def flush(self) -> Optional[str]:
+        """Emit whatever is held back (a genuinely incomplete tail at stream
+        end decodes with its replacement char)."""
+        if not self._pending:
+            return None
+        text = self.decode(self._pending)
+        self._pending = []
+        return text

@@ -1,0 +1,625 @@
+"""Continuous-batching scheduler + request lifecycle.
+
+``ServingEngine`` turns the repo's single-request jitted decode path
+(``inference/generate.py``) into a concurrent serving surface:
+
+- requests queue behind a bounded admission queue (backpressure: a full
+  queue REJECTS at submit time rather than stacking unbounded latency);
+- free slots admit queued requests: the prompt prefills into a fresh
+  single-row cache (padded to a power-of-two bucket so prompt length never
+  changes the jit signature), then ``SlotKVCache.insert`` copies it into the
+  slot;
+- every ``step()`` runs ONE fused decode step across all slots — padded and
+  masked so the compiled program is identical whatever the occupancy — then
+  retires slots that hit EOS, their token budget, a deadline, or a
+  cancellation;
+- each request carries its OWN rng chain and repetition-penalty mask,
+  threaded per-slot through the fused step, so its token trajectory is
+  IDENTICAL to what single-request ``generate()`` produces with the same
+  seed (tested byte-for-byte).
+
+Everything device-side is shape-static: admissions and retirements never
+recompile anything. The engine itself is synchronous (``step()``); a serving
+front end drives it from a background thread (``run()``) and talks to it
+through thread-safe ``submit()`` / ``RequestHandle``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import math
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from zero_transformer_tpu.inference.generate import (
+    _in_mesh,
+    decode_model,
+    init_cache,
+)
+from zero_transformer_tpu.inference.sampling import SamplingConfig, sample_token
+from zero_transformer_tpu.serving.slots import SlotKVCache
+
+# request terminal states
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+CANCELLED = "cancelled"
+EXPIRED = "expired"
+REJECTED = "rejected"
+FAILED = "failed"  # the ENGINE died, not the request
+
+_FINISHED = (DONE, CANCELLED, EXPIRED, REJECTED, FAILED)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request, in token-id space (detokenization is the
+    front end's job — the engine is tokenizer-agnostic)."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    seed: int = 0
+    # absolute deadline on the engine's clock (``engine.now()``); None = no
+    # deadline. Enforced both in the queue and mid-decode.
+    deadline: Optional[float] = None
+
+
+class RequestHandle:
+    """Thread-safe view of a submitted request: token stream + final state."""
+
+    def __init__(self, request: Request, rid: int, submitted_at: float):
+        self.request = request
+        self.id = rid
+        self.submitted_at = submitted_at
+        self.status = QUEUED
+        self.tokens: List[int] = []
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.error: Optional[str] = None
+        self._events: queue_mod.Queue = queue_mod.Queue()
+        self._done = threading.Event()
+        self._cancel = threading.Event()
+
+    # -- consumer side -----------------------------------------------------
+
+    def cancel(self) -> None:
+        """Ask the scheduler to drop this request (queued or mid-decode).
+        Takes effect at the next tick boundary; the handle finishes with
+        status ``cancelled``."""
+        self._cancel.set()
+
+    def next_event(self, timeout: Optional[float] = None):
+        """Blocking pop of the next ``("token", id)`` / ``("done", status)``
+        event, or None when ``timeout`` elapses first (lets a server poll
+        client liveness between events without killing the stream)."""
+        try:
+            return self._events.get(timeout=timeout)
+        except queue_mod.Empty:
+            return None
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield token ids as they generate; returns when the request
+        reaches a terminal state. ``timeout`` bounds the wait per token
+        (TimeoutError, same contract as ``result``)."""
+        while True:
+            event = self.next_event(timeout=timeout)
+            if event is None:
+                raise TimeoutError(
+                    f"request {self.id} produced no token in {timeout}s"
+                )
+            kind, value = event
+            if kind == "token":
+                yield value
+            else:
+                return
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until terminal, then return all emitted token ids
+        (including the EOS token when one was sampled)."""
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(f"request {self.id} still {self.status}")
+        return list(self.tokens)
+
+    # -- scheduler side ----------------------------------------------------
+
+    def _emit(self, token: int, now: float) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.tokens.append(token)
+        self._events.put(("token", token))
+
+    def _finish(self, status: str, now: float, error: Optional[str] = None) -> None:
+        self.status = status
+        self.error = error
+        self.finished_at = now
+        self._events.put(("done", status))
+        self._done.set()
+
+
+@dataclasses.dataclass
+class _ActiveSlot:
+    handle: RequestHandle
+    emitted: int = 0
+    last_emit_at: Optional[float] = None
+
+
+def _percentiles(values: Sequence[float], qs=(50, 90, 99)) -> Dict[str, float]:
+    """Nearest-rank percentiles of a host-side sample list (no numpy dance —
+    sample counts are small and this must be dependency-free). ceil, not
+    round: banker's rounding would make p50 of 5 samples the 2nd-smallest."""
+    if not values:
+        return {f"p{q}": 0.0 for q in qs}
+    ordered = sorted(values)
+    out = {}
+    for q in qs:
+        rank = max(0, min(len(ordered) - 1, math.ceil(q / 100 * len(ordered)) - 1))
+        out[f"p{q}"] = ordered[rank]
+    return out
+
+
+class ServingEngine:
+    """Slot-scheduled continuous batching over one jitted decode step.
+
+    Sampling semantics (temperature/top-k/top-p/penalty/greedy) are
+    ENGINE-level: they are static arguments baked into the compiled fused
+    step, so per-request variation would recompile per combination.
+    Requests carry what is cheap to vary: prompt, token budget, seed,
+    deadline.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params: Any,
+        n_slots: int = 4,
+        cache_len: Optional[int] = None,
+        sampling: SamplingConfig = SamplingConfig(),
+        eos_token_id: Optional[int] = None,
+        max_queue: int = 64,
+        mesh=None,
+        metrics=None,
+        metrics_interval: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg
+        self.cache_len = cache_len or cfg.max_seq_len
+        self.model = decode_model(cfg, self.cache_len)
+        self.params = params
+        self.sampling = sampling
+        self.eos_token_id = eos_token_id
+        self.mesh = mesh
+        self.now = clock
+        self.metrics = metrics
+        self.metrics_interval = metrics_interval
+
+        self.slots = SlotKVCache(self.model, n_slots, mesh=mesh)
+        self.n_slots = n_slots
+        V = cfg.vocab_size
+        self._last_logits = jnp.zeros((n_slots, V), jnp.float32)
+        self._gen_mask = jnp.zeros((n_slots, V), jnp.bool_)
+        self._rngs = jnp.stack([jax.random.PRNGKey(0)] * n_slots)
+        self._active: List[Optional[_ActiveSlot]] = [None] * n_slots
+
+        self._queue: deque = deque()
+        self.max_queue = max_queue
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._tick = 0
+        self._dead: Optional[str] = None  # set by _abort; submit() fails fast
+        # one zeroed single-row cache, built once: prefill's apply is
+        # functional (never mutates its input), so every admission reuses
+        # this template instead of paying an eval_shape retrace + a fresh
+        # device allocation per request
+        self._prefill_cache = init_cache(self.model, 1, mesh=mesh)
+
+        # serving counters / latency samples (host side)
+        self.stats: Dict[str, Any] = {
+            "submitted": 0,
+            "completed": 0,
+            "rejected_queue_full": 0,
+            "rejected_invalid": 0,
+            "expired_queued": 0,
+            "expired_decoding": 0,
+            "cancelled": 0,
+            "tokens_out": 0,
+            "peak_occupancy": 0,
+            "peak_queue_depth": 0,
+        }
+        # bounded: an unbounded all-time sample list on a long-lived server
+        # is a slow memory leak AND makes every /metrics snapshot pay an
+        # O(n log n) sort of the full history; recent-window percentiles are
+        # the operationally useful ones anyway
+        self._ttft: deque = deque(maxlen=10_000)
+        self._itl: deque = deque(maxlen=10_000)
+        self._started = self.now()
+
+    # ------------------------------------------------------------- admission
+
+    def _validate(self, request: Request) -> Optional[str]:
+        T = len(request.prompt)
+        if T < 1:
+            return "empty prompt"
+        if request.max_new_tokens < 1:
+            return "max_new_tokens must be >= 1"
+        # same bound as generate()._start_decode: the final token is never
+        # fed back, so the cache holds T + max_new - 1 positions
+        if T + request.max_new_tokens - 1 > self.cache_len:
+            return (
+                f"prompt ({T}) + max_new_tokens ({request.max_new_tokens}) "
+                f"exceeds cache_len ({self.cache_len})"
+            )
+        if (
+            self.cfg.position == "learned"
+            and T + request.max_new_tokens > self.cfg.max_seq_len
+        ):
+            return "learned positions cannot extrapolate past max_seq_len"
+        return None
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int = 32,
+        seed: int = 0,
+        deadline: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> RequestHandle:
+        """Enqueue a request; returns its handle immediately.
+
+        ``timeout`` (seconds from now) is sugar for an absolute ``deadline``.
+        A full queue or invalid request returns a handle already finished as
+        ``rejected`` (callers map that to HTTP 429 / 400) — the error string
+        says which.
+        """
+        now = self.now()
+        if timeout is not None:
+            deadline = now + timeout if deadline is None else min(deadline, now + timeout)
+        request = Request(list(prompt), int(max_new_tokens), int(seed), deadline)
+        handle = RequestHandle(request, next(self._ids), now)
+        invalid = self._validate(request)
+        with self._lock:
+            if self._dead is not None:
+                # the scheduler is gone — nothing will ever drain the queue,
+                # so enqueueing would hang the caller forever (checked under
+                # the lock: _abort drains the queue under the same lock)
+                handle._finish(FAILED, now, error=self._dead)
+                return handle
+            self.stats["submitted"] += 1
+            if invalid is not None:
+                self.stats["rejected_invalid"] += 1
+                handle._finish(REJECTED, now, error=invalid)
+                return handle
+            if len(self._queue) >= self.max_queue:
+                self.stats["rejected_queue_full"] += 1
+                handle._finish(
+                    REJECTED, now,
+                    error=f"queue full ({self.max_queue} waiting); retry later",
+                )
+                return handle
+            self._queue.append(handle)
+            self.stats["peak_queue_depth"] = max(
+                self.stats["peak_queue_depth"], len(self._queue)
+            )
+        return handle
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for a in self._active if a is not None)
+
+    # --------------------------------------------------------------- prefill
+
+    def _bucket(self, length: int) -> int:
+        """Smallest power-of-two >= length (floor 8) that the cache admits —
+        one compiled prefill per bucket instead of one per prompt length."""
+        cap = self.cache_len
+        if self.cfg.position == "learned":
+            cap = min(cap, self.cfg.max_seq_len)
+        b = 8
+        while b < length:
+            b *= 2
+        return min(b, cap)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _prefill_padded(model, params, padded, cache, true_len):  # noqa: N805
+        """Right-padded prefill. Causality makes K/V at positions < true_len
+        and the logits at true_len-1 exact regardless of the padding. The
+        returned cache's index leaves are whatever the padded apply left
+        (the bucket length) — ``SlotKVCache.insert`` alone owns setting the
+        slot's index to ``true_len``, so decode OVERWRITES the padded
+        garbage K/V progressively and the validity mask hides the rest."""
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache}, padded, mutable=["cache"]
+        )
+        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)
+        return last[:, 0].astype(jnp.float32), vars_out["cache"]
+
+    def _prefill(self, prompt: Sequence[int]):
+        T = len(prompt)
+        bucket = self._bucket(T)
+        padded = jnp.asarray(
+            [list(prompt) + [0] * (bucket - T)], jnp.int32
+        )
+        return _in_mesh(
+            self.mesh,
+            ServingEngine._prefill_padded,
+            self.model,
+            self.params,
+            padded,
+            self._prefill_cache,
+            jnp.int32(T),
+        )
+
+    # ----------------------------------------------------------- fused tick
+
+    @functools.partial(
+        jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4, 5, 6)
+    )
+    def _fused_step(model, sampling, params, last_logits, cache, gen_mask, rngs):  # noqa: N805
+        """Sample every slot from its own rng chain, then one fused forward.
+
+        Each row reproduces the single-request loop bit-for-bit: the rng
+        split order and the [1, V] sample shapes match ``generate()`` with
+        B=1, so a slot's trajectory is independent of its neighbors."""
+        split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
+        rngs, subs = split[:, 0], split[:, 1]
+
+        def sample_row(key, logits_row, mask_row):
+            return sample_token(key, logits_row[None], sampling, mask_row[None])[0]
+
+        token = jax.vmap(sample_row)(subs, last_logits, gen_mask)  # [S]
+        newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
+        gen_mask = gen_mask | newly
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
+        )
+        return (
+            token,
+            logits[:, -1, :].astype(jnp.float32),
+            vars_out["cache"],
+            gen_mask,
+            rngs,
+        )
+
+    @jax.jit
+    def _install_row(last_logits, gen_mask, rngs, slot, logits_row, key):  # noqa: N805
+        """Write one admitted request's per-slot state (prefill logits,
+        cleared penalty mask, fresh rng chain) into row ``slot``."""
+        last_logits = jax.lax.dynamic_update_slice(
+            last_logits, logits_row[None], (slot, 0)
+        )
+        gen_mask = jax.lax.dynamic_update_slice(
+            gen_mask,
+            jnp.zeros((1, gen_mask.shape[1]), gen_mask.dtype),
+            (slot, 0),
+        )
+        rngs = jax.lax.dynamic_update_slice(rngs, key[None], (slot, 0))
+        return last_logits, gen_mask, rngs
+
+    # -------------------------------------------------------------- schedule
+
+    def _admit(self) -> None:
+        while self.slots.free_count:
+            with self._lock:
+                handle = None
+                now = self.now()
+                while self._queue:
+                    cand = self._queue.popleft()
+                    if cand._cancel.is_set():
+                        self.stats["cancelled"] += 1
+                        cand._finish(CANCELLED, now)
+                    elif cand.request.deadline is not None and now > cand.request.deadline:
+                        self.stats["expired_queued"] += 1
+                        cand._finish(EXPIRED, now, error="deadline expired in queue")
+                    else:
+                        handle = cand
+                        break
+            if handle is None:
+                return
+            try:
+                logits_row, small_cache = self._prefill(handle.request.prompt)
+                slot = self.slots.acquire()
+                self.slots.insert(small_cache, slot, len(handle.request.prompt))
+                self._last_logits, self._gen_mask, self._rngs = _in_mesh(
+                    self.mesh,
+                    ServingEngine._install_row,
+                    self._last_logits,
+                    self._gen_mask,
+                    self._rngs,
+                    jnp.int32(slot),
+                    logits_row[0],
+                    jax.random.PRNGKey(handle.request.seed),
+                )
+            except Exception as exc:
+                # the popped handle is in neither the queue nor _active, so
+                # _abort() cannot reach it — finish it HERE or its client
+                # hangs forever while everyone else gets a clean failure
+                handle._finish(
+                    FAILED, self.now(), error=f"admission failed: {exc!r}"
+                )
+                raise
+            handle.status = RUNNING
+            self._active[slot] = _ActiveSlot(handle)
+            self.stats["peak_occupancy"] = max(
+                self.stats["peak_occupancy"], self.active_count
+            )
+
+    def _retire(self, finished: List[int]) -> None:
+        self.slots.release(finished)
+        for slot in finished:
+            self._active[slot] = None
+
+    def _sweep_active(self) -> None:
+        """Drop cancelled / past-deadline slots BEFORE the tick so their
+        token is neither computed against a dead deadline nor emitted."""
+        now = self.now()
+        finished = []
+        for slot, act in enumerate(self._active):
+            if act is None:
+                continue
+            if act.handle._cancel.is_set():
+                self.stats["cancelled"] += 1
+                act.handle._finish(CANCELLED, now)
+                finished.append(slot)
+            elif (
+                act.handle.request.deadline is not None
+                and now > act.handle.request.deadline
+            ):
+                self.stats["expired_decoding"] += 1
+                act.handle._finish(EXPIRED, now, error="deadline expired mid-decode")
+                finished.append(slot)
+        self._retire(finished)
+
+    def _sweep_queue(self) -> None:
+        """Finish cancelled / past-deadline requests still WAITING, every
+        tick — not only when a free slot lets ``_admit`` pop them. With all
+        slots busy on long generations, a queued request's deadline (and
+        ``cancel()``'s next-tick promise) must not wait for a slot to free."""
+        now = self.now()
+        with self._lock:
+            kept: deque = deque()
+            for cand in self._queue:
+                if cand._cancel.is_set():
+                    self.stats["cancelled"] += 1
+                    cand._finish(CANCELLED, now)
+                elif cand.request.deadline is not None and now > cand.request.deadline:
+                    self.stats["expired_queued"] += 1
+                    cand._finish(EXPIRED, now, error="deadline expired in queue")
+                else:
+                    kept.append(cand)
+            self._queue = kept
+
+    def step(self) -> bool:
+        """One scheduler tick: sweep, admit, fused decode, emit, retire.
+        Returns False when there was nothing to do (idle)."""
+        self._sweep_queue()
+        self._sweep_active()
+        self._admit()
+        if self.active_count == 0:
+            return False
+
+        token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs = _in_mesh(
+            self.mesh,
+            ServingEngine._fused_step,
+            self.model,
+            self.sampling,
+            self.params,
+            self._last_logits,
+            self.slots.cache,
+            self._gen_mask,
+            self._rngs,
+        )
+        tokens = jax.device_get(token).tolist()  # the per-tick host sync
+        now = self.now()
+        finished: List[int] = []
+        ttft_new: List[float] = []
+        itl_new: List[float] = []
+        for slot, act in enumerate(self._active):
+            if act is None:
+                continue
+            t = tokens[slot]
+            if act.emitted == 0:
+                ttft_new.append(now - act.handle.submitted_at)
+            elif act.last_emit_at is not None:
+                itl_new.append(now - act.last_emit_at)
+            act.handle._emit(t, now)
+            act.emitted += 1
+            act.last_emit_at = now
+            self.stats["tokens_out"] += 1
+            hit_eos = self.eos_token_id is not None and t == self.eos_token_id
+            if hit_eos or act.emitted >= act.handle.request.max_new_tokens:
+                act.handle._finish(DONE, now)
+                self.stats["completed"] += 1
+                finished.append(slot)
+        if ttft_new or itl_new:
+            # under the lock: metrics_snapshot copies these deques from HTTP
+            # handler threads, and CPython raises on a deque mutated
+            # mid-iteration
+            with self._lock:
+                self._ttft.extend(ttft_new)
+                self._itl.extend(itl_new)
+        self._retire(finished)
+
+        self._tick += 1
+        if (
+            self.metrics is not None
+            and self.metrics_interval
+            and self._tick % self.metrics_interval == 0
+        ):
+            self.metrics.log(self.metrics_snapshot(), step=self._tick, prefix="serve")
+        return True
+
+    def run(self, stop: threading.Event, idle_sleep: float = 0.001) -> None:
+        """Scheduler loop for a background thread: step until ``stop``.
+
+        A step() exception would otherwise kill the thread SILENTLY: every
+        in-flight handle waits forever on a 'done' event that never comes
+        while /healthz keeps answering — a hung total outage. Fail loudly
+        instead: finish every active and queued handle as ``failed`` (so
+        blocked clients unblock with the error), then re-raise."""
+        while not stop.is_set():
+            try:
+                busy = self.step()
+            except Exception as exc:
+                self._abort(f"scheduler died: {exc!r}")
+                raise
+            if not busy:
+                time.sleep(idle_sleep)
+        # graceful stop: anything still queued or mid-decode will never get
+        # another tick — finish it as failed so blocked consumers unblock
+        self._abort("engine stopped")
+
+    def _abort(self, reason: str) -> None:
+        """Terminate every outstanding request with ``failed`` and mark the
+        engine dead so later ``submit()`` calls fail fast too."""
+        now = self.now()
+        with self._lock:
+            self._dead = reason
+            queued, self._queue = list(self._queue), deque()
+        for handle in queued:
+            handle._finish(FAILED, now, error=reason)
+        for slot, act in enumerate(self._active):
+            if act is not None:
+                act.handle._finish(FAILED, now, error=reason)
+                self._active[slot] = None
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> None:
+        """Drive the scheduler synchronously until queue and slots drain
+        (test / batch harness; raises if it fails to converge)."""
+        for _ in range(max_ticks):
+            if not self.step() and self.queue_depth == 0:
+                return
+        raise RuntimeError(f"engine not idle after {max_ticks} ticks")
+
+    # --------------------------------------------------------------- metrics
+
+    def metrics_snapshot(self) -> Dict[str, float]:
+        """Aggregate serving metrics (milliseconds for latencies)."""
+        elapsed = max(self.now() - self._started, 1e-9)
+        snap: Dict[str, float] = {
+            "tokens_per_sec": self.stats["tokens_out"] / elapsed,
+            "slot_occupancy": self.active_count,
+            "queue_depth": len(self._queue),
+        }
+        with self._lock:  # step() extends these under the same lock
+            ttft, itl = list(self._ttft), list(self._itl)
+        for name, samples in (("ttft_ms", ttft), ("itl_ms", itl)):
+            for pct, val in _percentiles(samples).items():
+                snap[f"{name}_{pct}"] = val * 1e3
+        for k in (
+            "submitted", "completed", "rejected_queue_full", "rejected_invalid",
+            "expired_queued", "expired_decoding", "cancelled", "tokens_out",
+            "peak_occupancy", "peak_queue_depth",
+        ):
+            snap[k] = self.stats[k]
+        return snap

@@ -1,0 +1,261 @@
+"""Streaming HTTP front end for the continuous-batching engine.
+
+Stdlib-only (``http.server`` threads + SSE) so the serving surface works in
+this image without extra dependencies — the reference's only UI was a
+CUDA+gradio app (reference ``app.py``). Endpoints:
+
+- ``POST /generate``: JSON body ``{"prompt": str | "tokens": [int],
+  "max_new_tokens": int, "seed": int, "timeout": float, "stream": bool}``.
+  With ``stream`` (default true) the response is ``text/event-stream``: one
+  ``data: {"token": id, "text": piece}`` event per committed text piece and
+  a final ``data: {"done": true, "status": ..., "text": full}``. Without, a
+  single JSON document. Backpressure maps to HTTP 429 (queue full) / 400
+  (invalid request).
+- ``GET /healthz``: liveness + occupancy/queue snapshot.
+- ``GET /metrics``: the full serving-metrics snapshot (TTFT/ITL percentiles,
+  tokens/s, rejects) as JSON.
+
+One scheduler thread drives ``engine.step()``; HTTP handler threads only
+``submit()`` and drain per-request queues, so a slow client never stalls
+decode for everyone else (the whole point of continuous batching).
+"""
+from __future__ import annotations
+
+import json
+import select
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from zero_transformer_tpu.serving.detok import StreamDecoder, decode_tokens
+from zero_transformer_tpu.serving.engine import FAILED, REJECTED, ServingEngine
+
+# how long an SSE handler blocks on the next token before re-checking that
+# the client is still connected (a request parked in the admission queue, or
+# a half-open peer that will never RST, produces no write to fail on)
+_LIVENESS_POLL_S = 0.5
+
+
+def _client_gone(conn) -> bool:
+    """True when the peer has closed its end: for SSE the client sends
+    nothing after the POST body, so a READABLE socket whose peek returns
+    b'' is a FIN. Half-open peers (host gone, no FIN/RST) still need the
+    write-failure path — this catches the common orderly close."""
+    try:
+        readable, _, _ = select.select([conn], [], [], 0)
+        if readable:
+            return conn.recv(1, socket.MSG_PEEK) == b""
+    except OSError:
+        return True
+    return False
+
+
+class ServingServer:
+    """Own the HTTP server + the engine's scheduler thread."""
+
+    def __init__(self, engine: ServingEngine, tokenizer, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self._stop = threading.Event()
+        self._scheduler = threading.Thread(
+            target=engine.run, args=(self._stop,), name="serve-scheduler",
+            daemon=True,
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet by default; the engine's metrics logger is the log surface
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    # a dead scheduler thread means nothing will ever decode
+                    # again — that must not read as "ok" to a load balancer
+                    alive = outer._scheduler.is_alive() or not outer._scheduler.ident
+                    self._json(200 if alive else 503, {
+                        "status": "ok" if alive else "scheduler dead",
+                        "slots": outer.engine.n_slots,
+                        "active": outer.engine.active_count,
+                        "queued": outer.engine.queue_depth,
+                    })
+                elif self.path == "/metrics":
+                    self._json(200, outer.engine.metrics_snapshot())
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/generate":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self._json(400, {"error": "malformed JSON body"})
+                    return
+                if not isinstance(req, dict):
+                    # valid JSON but not an object ([1,2], "x") — still the
+                    # client's error, not a handler-thread traceback
+                    self._json(400, {"error": "body must be a JSON object"})
+                    return
+                outer._generate(self, req)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._scheduler.start()
+        self._server_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._server_thread.start()
+
+    def serve_forever(self) -> None:
+        self._scheduler.start()
+        try:
+            self._httpd.serve_forever()
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+
+    # -------------------------------------------------------------- request
+
+    def _submit(self, req: dict):
+        if "tokens" in req:
+            ids = [int(t) for t in req["tokens"]]
+        else:
+            ids = self.tokenizer.encode(str(req.get("prompt", "")).strip())
+        return self.engine.submit(
+            ids,
+            max_new_tokens=int(req.get("max_new_tokens", 32)),
+            seed=int(req.get("seed", 0)),
+            timeout=float(req["timeout"]) if "timeout" in req else None,
+        )
+
+    def _generate(self, handler, req: dict) -> None:
+        try:
+            handle = self._submit(req)
+        except (TypeError, ValueError) as exc:
+            # ill-typed field VALUES ({"timeout": "abc"}) are the client's
+            # error — 400, not a dropped connection with a server traceback
+            handler._json(400, {"error": f"bad request field: {exc}"})
+            return
+        if handle.status == REJECTED:
+            code = 429 if "queue full" in (handle.error or "") else 400
+            handler._json(code, {"error": handle.error, "status": handle.status})
+            return
+        if handle.status == FAILED:
+            # dead engine: an outage must read as 503, never as a 200 with
+            # zero tokens
+            handler._json(503, {"error": handle.error, "status": handle.status})
+            return
+        if not req.get("stream", True):
+            tokens = handle.result()
+            if handle.status == FAILED:
+                # the engine died AFTER admission — same outage as the
+                # submit-time check above, same 503 (never a 200 with an
+                # empty/truncated body a load balancer reads as healthy)
+                handler._json(503, {"error": handle.error, "status": handle.status})
+                return
+            text = self._full_text(tokens)
+            handler._json(200, {
+                "status": handle.status, "tokens": tokens, "text": text,
+            })
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.end_headers()
+        decoder = StreamDecoder(self.tokenizer)
+        pieces: list = []
+        eos = self.engine.eos_token_id
+        try:
+            # the EOS token is swallowed, not break-ed on: the loop must end
+            # on the 'done' event so handle.status is terminal by the time
+            # the final SSE event reports it (the engine emits the eos token
+            # BEFORE finishing the handle — an early break races that)
+            while True:
+                event = handle.next_event(timeout=_LIVENESS_POLL_S)
+                if event is None:
+                    # no token yet (queued, or a slow tick): is the client
+                    # still there? A disconnected client must not hold a
+                    # queue position — or later a slot — for a generation
+                    # nobody will read
+                    if _client_gone(handler.connection):
+                        handle.cancel()
+                        return
+                    continue
+                kind, token = event
+                if kind != "token":
+                    break
+                if eos is not None and token == eos:
+                    continue
+                piece = decoder.push(token)
+                if piece is not None:
+                    pieces.append(piece)
+                    self._event(handler, {"token": token, "text": piece})
+            tail = decoder.flush()
+            if tail is not None:
+                pieces.append(tail)
+                self._event(handler, {"text": tail})
+            self._event(handler, {
+                "done": True,
+                "status": handle.status,
+                "text": "".join(pieces),
+                "error": handle.error,
+            })
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away: release the slot instead of decoding into
+            # the void
+            handle.cancel()
+
+    def _event(self, handler, obj) -> None:
+        handler.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+        handler.wfile.flush()
+
+    def _full_text(self, tokens) -> str:
+        eos = self.engine.eos_token_id
+        return decode_tokens(self.tokenizer, [t for t in tokens if t != eos])
+
+
+def run_server(
+    engine: ServingEngine,
+    tokenizer,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    background: bool = False,
+) -> Optional[ServingServer]:
+    """Start the serving front end. ``background=True`` returns the running
+    server (tests); otherwise blocks until interrupted."""
+    server = ServingServer(engine, tokenizer, host=host, port=port)
+    if background:
+        server.start()
+        return server
+    print(
+        f"serving on http://{host}:{server.port} "
+        f"({engine.n_slots} slots, cache_len {engine.cache_len}) — "
+        "POST /generate, GET /healthz, GET /metrics",
+        flush=True,
+    )
+    server.serve_forever()
+    return None

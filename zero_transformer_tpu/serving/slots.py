@@ -1,0 +1,175 @@
+"""Slot-based KV-cache manager for continuous batching.
+
+One fixed ``[n_slots, cache_len]`` decode cache (allocated through
+``inference.init_cache`` — int8-KV aware, optionally tensor-sharded) whose
+rows are SLOTS: a request is prefetched into a fresh single-row cache, then
+copied into a free slot with ``lax.dynamic_update_slice``; from then on every
+scheduler tick runs ONE fused decode step over all slots. The piece that
+makes rows independent is the cache index: ``init_cache`` gives the scalar
+``cache_index``/``decode_pos`` the single-request paths use, and
+``vectorize_index`` widens it to a per-slot ``[n_slots]`` vector — the
+model's decode path (``models.gpt.Attention``) sees a vector index and
+switches every position-dependent computation (writes, validity mask, RoPE /
+ALiBi / causal biases) to per-row form.
+
+Jit-signature stability invariant: every device function here is traced for
+ONE shape — the full ``[n_slots, ...]`` cache with dynamic slot/length
+scalars — so admissions, retirements, and occupancy changes never recompile.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from zero_transformer_tpu.inference.generate import init_cache
+
+# cache leaves that hold POSITIONS, not K/V data; widened per-slot.
+# (cache_index: per-layer attention write position; decode_pos: the learned-
+# position table offset at the Transformer level.)
+INDEX_LEAVES = ("cache_index", "decode_pos")
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return str(last.key if hasattr(last, "key") else last)
+
+
+def _cache_struct(model, batch: int):
+    """Shape-only cache structure for a [batch, ...] run (no materialization)."""
+    from zero_transformer_tpu.utils.jax_compat import clear_abstract_mesh
+
+    with clear_abstract_mesh():
+        return jax.eval_shape(
+            lambda r: model.init(r, jnp.zeros((batch, 1), jnp.int32)),
+            jax.random.PRNGKey(0),
+        )["cache"]
+
+
+def vectorize_index(cache: Any, n_slots: int) -> Any:
+    """Widen scalar index leaves to per-slot vectors: shape ``s`` -> ``s + (n_slots,)``
+    int32 zeros. K/V leaves pass through untouched (same buffers)."""
+
+    def widen(path, leaf):
+        if _leaf_name(path) in INDEX_LEAVES:
+            return jnp.zeros(leaf.shape + (n_slots,), jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(widen, cache)
+
+
+@jax.jit
+def _reset_index(cache: Any, keep: jax.Array) -> Any:
+    """Zero the positions of retired slots (``keep`` [n_slots] bool). K/V
+    rows are left in place — the validity mask (positions < index) already
+    excludes them, and the next insert overwrites the row."""
+
+    def reset(path, leaf):
+        if _leaf_name(path) in INDEX_LEAVES:
+            return jnp.where(keep, leaf, 0)  # keep broadcasts from the right
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(reset, cache)
+
+
+class SlotKVCache:
+    """Owns the engine's fixed-shape cache + host-side slot bookkeeping.
+
+    Device state: ``self.cache`` (the [n_slots, cache_len] tree, vector
+    index). Host state: which slots are free. The manager is not thread-safe
+    by itself — the engine serializes access from its scheduler loop.
+    """
+
+    def __init__(self, model, n_slots: int, mesh=None):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        self.model = model
+        self.n_slots = n_slots
+        self.mesh = mesh
+        self.cache = vectorize_index(
+            init_cache(model, n_slots, mesh=mesh), n_slots
+        )
+        self._free: List[int] = list(range(n_slots))
+        self._axes = self._find_batch_axes(model)
+        self._insert = self._build_insert()
+
+    @staticmethod
+    def _find_batch_axes(model) -> Dict[str, int]:
+        """Per-leaf batch-axis index, found by diffing the cache structure
+        for batch=1 vs batch=2 — shape-sniffing a single structure would
+        misread layouts where the slot count collides with another dim
+        (n_layers == n_slots under the scanned stack). Index leaves don't
+        scale with batch (scalar per layer) and get no entry — insert
+        handles them by name."""
+        one = jax.tree_util.tree_leaves_with_path(_cache_struct(model, 1))
+        two = jax.tree_util.tree_leaves_with_path(_cache_struct(model, 2))
+        axes: Dict[str, int] = {}
+        for (path, a), (path2, b) in zip(one, two):
+            assert path == path2, "cache structure must not depend on batch"
+            diff = [i for i, (x, y) in enumerate(zip(a.shape, b.shape)) if x != y]
+            if diff:
+                axes[jax.tree_util.keystr(path)] = diff[0]
+        return axes
+
+    def _build_insert(self):
+        axes = self._axes
+
+        @jax.jit
+        def insert(big, small, slot, true_len):
+            def upd(path, b, s):
+                if _leaf_name(path) in INDEX_LEAVES:
+                    # set [..., slot] = true_len
+                    block = jnp.full(b.shape[:-1] + (1,), true_len, b.dtype)
+                    starts = (0,) * (b.ndim - 1) + (slot,)
+                    return jax.lax.dynamic_update_slice(b, block, starts)
+                ax = axes.get(jax.tree_util.keystr(path))
+                if ax is None:
+                    # leaf does not scale with batch and is not an index —
+                    # shared state; keep the engine's copy
+                    return b
+                starts = [0] * b.ndim
+                starts[ax] = slot
+                return jax.lax.dynamic_update_slice(
+                    b, s.astype(b.dtype), tuple(starts)
+                )
+
+            return jax.tree_util.tree_map_with_path(upd, big, small)
+
+        return insert
+
+    # ---- slot bookkeeping ------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def acquire(self) -> Optional[int]:
+        """Claim a free slot index, or None when fully occupied."""
+        return self._free.pop(0) if self._free else None
+
+    def insert(self, small_cache: Any, slot: int, true_len: int) -> None:
+        """Copy a prefilled single-row cache into ``slot`` and set its
+        position to ``true_len`` (the PROMPT length, not the padded prefill
+        length — decode overwrites any padded tail progressively)."""
+        self.cache = self._insert(
+            self.cache, small_cache, jnp.int32(slot), jnp.int32(true_len)
+        )
+
+    def release(self, slots: List[int]) -> None:
+        """Retire slots: free them and zero their positions so a parked row
+        never walks its index toward the capacity poison guard."""
+        if not slots:
+            return
+        for s in slots:
+            if s in self._free:
+                raise ValueError(f"slot {s} double-released")
+            self._free.append(s)
+        keep = jnp.asarray(
+            [s not in self._free for s in range(self.n_slots)], jnp.bool_
+        )
+        self.cache = _reset_index(self.cache, keep)

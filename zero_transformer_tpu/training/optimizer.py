@@ -19,6 +19,10 @@ import optax
 
 from zero_transformer_tpu.config import OptimizerConfig
 
+# optax renamed safe_int32_increment -> safe_increment; accept either so the
+# pinned-older-optax images keep working
+_safe_increment = getattr(optax, "safe_increment", None) or optax.safe_int32_increment
+
 
 def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
     if cfg.schedule == "constant":
@@ -257,7 +261,7 @@ def _sharded_factored_rms(
             lambda t: t[i], out, is_leaf=lambda x: isinstance(x, tuple)
         )
         new_state = FactoredState(
-            count=optax.safe_increment(state.count),
+            count=_safe_increment(state.count),
             v_row=pick(1), v_col=pick(2), v=pick(3),
         )
         return pick(0), new_state
