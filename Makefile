@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full chaos bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -18,6 +18,12 @@ test:
 # Full lane: the whole suite, nightly in CI.
 test-full:
 	$(PY) -m pytest tests/ -x -q $(PYTEST_ARGS)
+
+# Fault-injection lane: every chaos-marked scenario (supervised recovery
+# from injected loader/checkpoint/hang/preemption faults). The deterministic
+# fast resilience cases are UN-marked and already run in the quick lane.
+chaos:
+	$(PY) -m pytest tests/test_resilience.py -q -m chaos $(PYTEST_ARGS)
 
 # One-line JSON benchmark artifact (driver contract).
 bench:

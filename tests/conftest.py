@@ -51,3 +51,8 @@ def _assert_cpu():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test (excluded from quick CI lane)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection scenario (supervisor restarts, watchdog "
+        "aborts, injected IO failures) — `make chaos` runs just these",
+    )

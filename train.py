@@ -52,6 +52,16 @@ def main():
     parser = argparse.ArgumentParser(description="TPU-native ZeRO transformer trainer")
     parser.add_argument("--cfg", default="configs/train_test.yaml")
     parser.add_argument("--resume", action="store_true", default=False)
+    parser.add_argument(
+        "--supervise",
+        action="store_true",
+        default=False,
+        help="run under the in-process supervisor: bounded restarts with "
+        "exponential backoff on retryable failures (loader/storage IO, "
+        "hangs, preemption), resuming from the last good checkpoint each "
+        "time; fatal config/shape errors still exit immediately. Budget and "
+        "backoff come from the `resilience` config block",
+    )
     parser.add_argument("--wandb", action="store_true", default=False)
     parser.add_argument("--max-steps", type=int, default=None)
     parser.add_argument(
@@ -125,6 +135,11 @@ def main():
                 f"{v / gb:.2f} GiB" if "_bytes" in k and isinstance(v, int) else v,
             )
         print(json.dumps(report), flush=True)
+        return
+    if args.supervise:
+        from zero_transformer_tpu.resilience import Supervisor
+
+        Supervisor(cfg, use_wandb=args.wandb).run(max_steps=args.max_steps)
         return
     trainer = Trainer(cfg, use_wandb=args.wandb)
     try:

@@ -119,6 +119,26 @@ class CheckpointManager:
         not to defer validation)."""
         self._mgr
 
+    def check_for_errors(self) -> None:
+        """Surface a FAILED async save now instead of at ``wait()``/``close()``.
+
+        Orbax's async commit thread parks its exception until someone joins
+        it — historically that was hours later at run teardown, long after a
+        dead bucket stopped persisting anything (every "checkpoint" since
+        silently lost). Polled on every ``save()`` tick so a broken
+        destination kills the run within one save interval. Storage-free:
+        a manager that never saved has nothing to poll. Older orbax without
+        ``check_for_errors`` degrades to the historical at-exit behavior."""
+        if self._mgr_inst is None:
+            return
+        check = getattr(self._mgr_inst, "check_for_errors", None)
+        if check is None:
+            # older orbax: the AsyncCheckpointer underneath holds the thread
+            inner = getattr(self._mgr_inst, "_checkpointer", None)
+            check = getattr(inner, "check_for_errors", None)
+        if check is not None:
+            check()
+
     def save(
         self,
         step: int,
@@ -127,6 +147,9 @@ class CheckpointManager:
         force: bool = False,
     ) -> bool:
         """Save if ``step`` falls on the save interval (or ``force``)."""
+        # a previous async save that died must fail THIS run promptly, not
+        # hours later when wait()/close() finally joins the commit thread
+        self.check_for_errors()
         if not force and (step == 0 or step % self.save_frequency != 0):
             return False
         return self._mgr.save(

@@ -318,6 +318,85 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance policy (``zero_transformer_tpu/resilience/``).
+
+    Three layers, all host-side except the anomaly guard:
+
+    - **anomaly guard**: every train step is checked IN-GRAPH for non-finite
+      loss/grad-norm (and, optionally, spikes against a running EMA); a
+      flagged step's update is dropped inside the compiled step, so a
+      divergent batch can never poison params — and detection costs no extra
+      device→host sync on non-logging steps (the carry is a device array the
+      host only reads at log points). This closes the ``halt_on_nan``
+      blind spot where divergence between log points poisoned up to
+      ``log_frequency - 1`` further updates.
+    - **rollback snapshot**: state mirrored to host RAM every
+      ``snapshot_frequency`` steps; on a sustained anomaly streak the last
+      good snapshot is restored (no disk read) and the loader continues
+      forward — the offending data window is never replayed.
+    - **watchdog / supervisor**: hang detection and bounded-restart
+      supervision of the whole run (``train.py --supervise``).
+    """
+
+    # in-graph per-step anomaly guard (non-finite loss/grad always flags)
+    anomaly_detection: bool = True
+    # escalation ceiling when an anomaly is detected: "skip_batch" only ever
+    # drops flagged updates; "rollback" additionally restores the host-RAM
+    # snapshot after `rollback_after` consecutive anomalies; "halt" raises at
+    # the first detection (the historical halt_on_nan semantics).
+    anomaly_response: str = "halt"
+    # >0: flag loss > factor * EMA(loss) as an anomaly (0 = non-finite only)
+    loss_spike_factor: float = 0.0
+    # >0: flag grad_norm > factor * EMA(grad_norm)
+    grad_spike_factor: float = 0.0
+    ema_decay: float = 0.98
+    # clean steps absorbed into the EMAs before spike checks arm
+    spike_warmup_steps: int = 50
+    # consecutive flagged steps before skip_batch escalates to halt (the
+    # guard keeps params clean, but zero progress forever is its own failure)
+    max_consecutive_anomalies: int = 25
+    # rollback policy: restore the snapshot once a streak reaches this length
+    rollback_after: int = 3
+    snapshot_frequency: int = 200  # steps between host-RAM state mirrors
+    max_rollbacks: int = 3  # budget per train() call; exceeding it halts
+    # hang watchdog: abort (retryably) when no step completes for this many
+    # seconds; 0 disables. Must comfortably exceed worst-case compile +
+    # checkpoint-write time.
+    watchdog_timeout_s: float = 0.0
+    # supervisor (train.py --supervise): restart budget + exponential backoff
+    max_restarts: int = 3
+    backoff_base_s: float = 2.0
+    backoff_max_s: float = 300.0
+
+    def __post_init__(self):
+        if self.anomaly_response not in ("skip_batch", "rollback", "halt"):
+            raise ValueError(
+                f"invalid anomaly_response {self.anomaly_response!r}; expected "
+                "'skip_batch', 'rollback', or 'halt'"
+            )
+        if not 0.0 < self.ema_decay < 1.0:
+            raise ValueError("ema_decay must be in (0, 1)")
+        for name in ("loss_spike_factor", "grad_spike_factor"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0 (0 disables)")
+        for name in (
+            "rollback_after",
+            "max_consecutive_anomalies",
+        ):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        if self.snapshot_frequency < 0 or self.max_rollbacks < 0:
+            raise ValueError("snapshot_frequency/max_rollbacks must be >= 0")
+        if self.watchdog_timeout_s < 0 or self.max_restarts < 0:
+            raise ValueError("watchdog_timeout_s/max_restarts must be >= 0")
+        if self.backoff_base_s <= 0 or self.backoff_max_s < self.backoff_base_s:
+            raise ValueError(
+                "backoff_base_s must be > 0 and backoff_max_s >= backoff_base_s"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointConfig:
     directory: str = "checkpoints"
     keep: int = 5
@@ -339,6 +418,7 @@ class Config:
     training: TrainingConfig = dataclasses.field(default_factory=TrainingConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = dataclasses.field(default_factory=ResilienceConfig)
 
 
 def _build(cls, raw: dict) -> Any:
@@ -394,6 +474,7 @@ def load_config(path: str | Path, **overrides) -> Config:
         ("training", TrainingConfig),
         ("data", DataConfig),
         ("checkpoint", CheckpointConfig),
+        ("resilience", ResilienceConfig),
     ):
         if key in raw:
             sections[key] = _build(cls, raw.pop(key) or {})

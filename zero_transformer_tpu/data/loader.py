@@ -227,6 +227,14 @@ class DataLoader:
         self.source.seek(n if self.pre_striped else n * self.process_count)
         self.steps_consumed += n_steps
 
+    def fault_counters(self) -> dict:
+        """Data-path fault accounting from the source (shard retries, skipped
+        shards/members — ``TarShardSource.fault_counters``), reported by the
+        Trainer through ``MetricsLogger`` at log points. Sources without
+        fault accounting contribute nothing."""
+        counters = getattr(self.source, "fault_counters", None)
+        return dict(counters) if isinstance(counters, dict) else {}
+
     def state(self) -> dict:
         """Resume token. Only the step count: per-process source positions
         diverge mid-stripe (the striped generator reads ahead to find its

@@ -35,6 +35,7 @@ import logging
 import os
 import re
 import tarfile
+import time
 from pathlib import Path
 from typing import Iterator, List, Sequence
 
@@ -185,6 +186,7 @@ class TarShardSource(ReplayStreamSource):
         stripe_shards: bool | str = "auto",
         strict: bool = False,
         legacy_cwd_fallback: bool | None = None,
+        retry_backoff_s: float = 1.0,
     ):
         if isinstance(shards, (str, Path)):
             shards = [str(shards)]
@@ -215,6 +217,16 @@ class TarShardSource(ReplayStreamSource):
         # this process's rows, so its row striping must be skipped.
         self.pre_striped = bool(stripe_shards) and process_count > 1
         self.strict = strict
+        self.retry_backoff_s = retry_backoff_s
+        # fault accounting, surfaced through DataLoader.fault_counters() into
+        # the metrics stream: a multi-day pod run must SHOW what it skipped
+        # (silent skips reshape the data distribution invisibly)
+        self.fault_counters: dict[str, int] = {
+            "shard_retries": 0,
+            "skipped_shards": 0,
+            "skipped_shard_remainders": 0,
+            "skipped_members": 0,
+        }
         super().__init__()
 
     def _shard_order(self, epoch: int) -> List[str]:
@@ -240,6 +252,7 @@ class TarShardSource(ReplayStreamSource):
                 except Exception:
                     if self.strict:
                         raise
+                    self.fault_counters["skipped_members"] += 1
                     log.warning(
                         "skipping undecodable member %s in %s",
                         member.name, shard, exc_info=True,
@@ -275,10 +288,24 @@ class TarShardSource(ReplayStreamSource):
                         if self.strict:
                             raise
                         if attempt < 2 and from_this_shard == 0:
+                            self.fault_counters["shard_retries"] += 1
+                            # bounded exponential backoff: a remote-IO blip
+                            # (bucket throttle, connection reset) clears in
+                            # seconds; an immediate re-open mostly re-fails
+                            delay = self.retry_backoff_s * (2.0 ** attempt)
                             log.warning(
-                                "retrying shard %s (attempt %d)", shard, attempt + 2
+                                "retrying shard %s in %.1fs (attempt %d)",
+                                shard, delay, attempt + 2,
                             )
+                            if delay > 0:
+                                time.sleep(delay)
                             continue
+                        key = (
+                            "skipped_shard_remainders"
+                            if from_this_shard
+                            else "skipped_shards"
+                        )
+                        self.fault_counters[key] += 1
                         log.warning(
                             "skipping %s of shard %s",
                             "remainder" if from_this_shard else "all",

@@ -1,0 +1,54 @@
+"""Fault tolerance for long preemptible pod runs.
+
+The paper's premise is multi-day pretraining on preemptible TPU pods, yet the
+reference's whole recovery story was "rerun with ``--resume``" (reference
+``main_zero.py:48-52``). GSPMD-era stacks make the *partitioned program*
+deterministic and restartable (GSPMD, arXiv:2105.04663); what is missing is
+the host-side machinery that notices failure and restarts without a human.
+This package is that machinery, in four layers (docs/RESILIENCE.md has the
+full fault → detection → response → recovery matrix):
+
+- ``anomaly``   — in-graph per-step loss/grad guard: a flagged update is
+                  dropped inside the compiled step (params can never be
+                  poisoned by one bad batch), with skip → rollback → halt
+                  escalation handled host-side at log points only;
+- ``watchdog``  — heartbeat deadline on the train loop: dump stacks,
+                  force-save, abort retryably so the supervisor restarts;
+- ``supervisor``— in-process bounded-restart loop with exponential backoff
+                  and retryable-vs-fatal exception classification
+                  (``train.py --supervise``);
+- ``chaos``     — fault injection (NaN step, loader error, SIGTERM, failed
+                  or slow checkpoint write, hung step) proving the above in
+                  ``tests/test_resilience.py``.
+"""
+from __future__ import annotations
+
+
+class RetryableError(RuntimeError):
+    """An error worth restarting from the last good checkpoint: transient
+    storage/loader/XLA failures, hangs, preemptions. The supervisor's
+    classifier treats subclasses (and a pattern-matched set of foreign
+    exceptions — see ``supervisor.classify``) as restart candidates."""
+
+
+class HangError(RetryableError):
+    """The watchdog found the train loop stalled past its deadline."""
+
+
+class AnomalyHalt(RuntimeError):
+    """The anomaly policy escalated to halt (non-finite loss / spike streak /
+    rollback budget exhausted). Deliberately FATAL to the supervisor: a run
+    that diverges identically from its last good checkpoint would loop
+    restarts forever — this needs a human (lower LR, inspect data window)."""
+
+
+from zero_transformer_tpu.resilience.anomaly import (  # noqa: E402,F401
+    AnomalyGuard,
+    HostSnapshot,
+)
+from zero_transformer_tpu.resilience.chaos import ChaosMonkey, Fault  # noqa: E402,F401
+from zero_transformer_tpu.resilience.supervisor import (  # noqa: E402,F401
+    Supervisor,
+    classify,
+)
+from zero_transformer_tpu.resilience.watchdog import Watchdog  # noqa: E402,F401

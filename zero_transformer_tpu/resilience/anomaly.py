@@ -1,0 +1,196 @@
+"""In-graph anomaly guard: detect-and-drop divergent updates, no host sync.
+
+The trainer's historical defense was ``halt_on_nan``, which inspects the loss
+only on ``step % log_frequency == 0`` steps — divergence at any other step
+poisoned up to ``log_frequency - 1`` further updates before detection
+(the blind spot this module closes). Syncing the loss to host EVERY step
+would fix that but serializes dispatch against compute and stalls the pipeline
+the whole hot loop is built around.
+
+The guard instead moves detection *into the compiled step*:
+
+- ``bad`` = non-finite loss/grad-norm, or (optionally) a spike against a
+  running EMA of either — all computed on device from metrics the step
+  already produces;
+- the state update is SELECTED, not applied: ``where(bad, old, new)`` over
+  params and optimizer state, so a flagged update never lands. The step
+  counter still advances — the batch is consumed (skipped), not retried;
+- a tiny replicated carry (anomaly count, current streak, the EMAs) threads
+  through the loop as device arrays. The host fetches it only at log points,
+  so the non-logging path has ZERO additional device→host transfers
+  (asserted under ``jax.transfer_guard`` in tests/test_resilience.py).
+
+Escalation beyond skipping — rollback to the host-RAM snapshot, or halt — is
+a host-side decision made from the carry at log points (resilience config
+``anomaly_response``); see ``training/trainer.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from zero_transformer_tpu.config import ResilienceConfig
+from zero_transformer_tpu.parallel.zero import TrainState, _with_ambient_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyStats:
+    """Host-side view of the guard carry (one fetch per log point)."""
+
+    count: int  # total flagged (dropped) steps this run
+    streak: int  # consecutive flagged steps ending at the current step
+    loss_ema: float
+    grad_ema: float
+
+
+class AnomalyGuard:
+    """Wraps a jitted train step with the in-graph detect-and-drop guard.
+
+    The wrapped step has signature ``(state, batch, rng, carry) ->
+    (state, metrics, carry)``; both state and carry are donated. The inner
+    step may be any of the trainer's step variants (GSPMD hint, explicit
+    ZeRO shard_map core, pipeline wavefront) — the guard only needs the
+    ``loss``/``grad_norm`` metrics every variant already returns, and the
+    select respects whatever sharding the plan dictates.
+    """
+
+    def __init__(self, cfg: ResilienceConfig, mesh, plan, batch_sharding):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.plan = plan
+        self.batch_sharding = batch_sharding
+        self._replicated = NamedSharding(mesh, P())
+
+    def init_carry(self) -> dict:
+        zero = lambda dt: jnp.zeros((), dt)  # noqa: E731
+        carry = {
+            "count": zero(jnp.int32),
+            "streak": zero(jnp.int32),
+            "loss_ema": zero(jnp.float32),
+            "grad_ema": zero(jnp.float32),
+            # clean steps absorbed into the EMAs (spike checks arm at
+            # spike_warmup_steps)
+            "seen": zero(jnp.int32),
+        }
+        return jax.device_put(carry, self._replicated)
+
+    def _flag(self, loss, grad_norm, carry):
+        cfg = self.cfg
+        loss = loss.astype(jnp.float32)
+        grad_norm = grad_norm.astype(jnp.float32)
+        bad = ~(jnp.isfinite(loss) & jnp.isfinite(grad_norm))
+        warm = carry["seen"] >= cfg.spike_warmup_steps
+        if cfg.loss_spike_factor > 0:
+            bad |= warm & (loss > cfg.loss_spike_factor * carry["loss_ema"])
+        if cfg.grad_spike_factor > 0:
+            bad |= warm & (grad_norm > cfg.grad_spike_factor * carry["grad_ema"])
+        return bad
+
+    def _advance_carry(self, bad, loss, grad_norm, carry):
+        d = self.cfg.ema_decay
+        loss = loss.astype(jnp.float32)
+        grad_norm = grad_norm.astype(jnp.float32)
+
+        def ema(prev, x):
+            # first clean sample seeds the EMA; flagged samples never enter it
+            seeded = jnp.where(carry["seen"] == 0, x, d * prev + (1.0 - d) * x)
+            return jnp.where(bad, prev, seeded)
+
+        return {
+            "count": carry["count"] + bad.astype(jnp.int32),
+            "streak": jnp.where(bad, carry["streak"] + 1, 0).astype(jnp.int32),
+            "loss_ema": ema(carry["loss_ema"], loss),
+            "grad_ema": ema(carry["grad_ema"], grad_norm),
+            "seen": carry["seen"] + (~bad).astype(jnp.int32),
+        }
+
+    def wrap(self, train_step: Callable) -> Callable:
+        def guarded(state: TrainState, batch, rng, carry):
+            new_state, metrics = train_step(state, batch, rng)
+            bad = self._flag(metrics["loss"], metrics["grad_norm"], carry)
+            keep = lambda old, new: jnp.where(bad, old, new)  # noqa: E731
+            # the step counter always advances (the batch is consumed either
+            # way); only the learned state is protected
+            guarded_state = TrainState(
+                step=new_state.step,
+                params=jax.tree.map(keep, state.params, new_state.params),
+                opt_state=jax.tree.map(keep, state.opt_state, new_state.opt_state),
+            )
+            metrics = dict(metrics)
+            metrics["anomaly"] = bad.astype(jnp.float32)
+            return guarded_state, metrics, self._advance_carry(
+                bad, metrics["loss"], metrics["grad_norm"], carry
+            )
+
+        rep = self._replicated
+        return _with_ambient_mesh(
+            jax.jit(
+                guarded,
+                in_shardings=(self.plan.state, self.batch_sharding, rep, rep),
+                out_shardings=(self.plan.state, rep, rep),
+                donate_argnums=(0, 3),
+            ),
+            self.mesh,
+        )
+
+    def read(self, carry) -> AnomalyStats:
+        """Fetch the carry to host — call ONLY at log/check points (this is
+        the device sync the per-step path deliberately avoids)."""
+        host = jax.device_get(carry)
+        return AnomalyStats(
+            count=int(host["count"]),
+            streak=int(host["streak"]),
+            loss_ema=float(host["loss_ema"]),
+            grad_ema=float(host["grad_ema"]),
+        )
+
+
+class HostSnapshot:
+    """Cheap host-RAM mirror of a known-good TrainState for rollback.
+
+    ``capture`` copies the (sharded) device state to host numpy; ``restore``
+    places it back into each leaf's original sharding. No disk involved —
+    rollback latency is one device_put of the state, vs a checkpoint restore
+    that would also be limited to ``save_frequency`` granularity and storage
+    bandwidth. The loader is deliberately NOT part of the snapshot: after a
+    rollback the stream continues forward, past the offending window
+    (replaying the same batches into the same state would diverge again).
+    """
+
+    def __init__(self):
+        self.step: Optional[int] = None
+        self._state: Optional[TrainState] = None
+        self._shardings: Any = None
+
+    @property
+    def captured(self) -> bool:
+        return self._state is not None
+
+    def capture(self, state: TrainState) -> None:
+        self._shardings = jax.tree.map(lambda leaf: leaf.sharding, state)
+        # COPY, never view: on the CPU backend device_get can return a
+        # zero-copy view of the XLA buffer, and the train step will donate
+        # (and reuse) that buffer on the very next call — a viewing snapshot
+        # is silently corrupted, then rollback restores garbage
+        self._state = jax.tree.map(
+            lambda leaf: np.array(jax.device_get(leaf), copy=True), state
+        )
+        self.step = int(self._state.step)
+
+    def restore(self) -> TrainState:
+        if self._state is None:
+            raise RuntimeError("no snapshot captured")
+        from zero_transformer_tpu.utils.jax_compat import ensure_donatable
+
+        placed = jax.tree.map(jax.device_put, self._state, self._shardings)
+        # device_put from host numpy can be ZERO-COPY (the jax array shares
+        # the numpy heap buffer), and the train step DONATES its input state
+        # — XLA would then recycle a buffer it does not own and corrupt the
+        # host heap (observed as a glibc abort on the CPU backend); see
+        # jax_compat.ensure_donatable
+        return ensure_donatable(placed)

@@ -1,0 +1,202 @@
+"""Chaos injection: deterministic faults to prove the resilience layer.
+
+A fault-tolerance subsystem that has only ever seen healthy runs is a guess.
+``ChaosMonkey`` injects the failure modes a preemptible pod run actually
+hits — divergent steps, loader IO errors, SIGTERM preemption, failing or
+slow checkpoint writes, hung steps — at exact step boundaries, so
+``tests/test_resilience.py`` can assert end-state parity between a faulted
+supervised run and an undisturbed one.
+
+Injection points mirror where real faults enter:
+
+- ``wrap_train_step``: an IN-GRAPH poison — at ``state.step`` inside the
+  fault window, loss/grad-norm/params all go NaN, exactly what a divergent
+  update looks like from outside the step. Traced into the jitted step, so
+  the anomaly guard sees it through the same metrics path as a real NaN
+  (a host-side monkeypatch would bypass the compiled guard entirely).
+- ``wrap_loader`` / ``wrap_checkpoint``: proxy objects raising (or delaying)
+  at a chosen batch/step — storage faults at the exact API surface the
+  trainer calls.
+- ``on_step``: host-side faults the trainer invokes once per completed step
+  (SIGTERM to this process; an interruptible busy-hang for the watchdog).
+
+One-shot semantics are host-side: a ``Fault`` records having fired and stays
+fired across supervisor restarts when the same monkey is reused — so "fault
+once, recover, complete" is expressible. The in-graph poison is windowed on
+``state.step`` instead (it cannot observe host state from inside the trace);
+rollback never replays it because rollback keeps the step counter moving
+forward (see docs/RESILIENCE.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("zero_transformer_tpu")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One injectable fault.
+
+    kind: "nan_step" | "loader_error" | "sigterm" | "ckpt_fail" |
+          "ckpt_slow" | "hang"
+    step: step at which to fire. For "nan_step" this is matched against the
+      in-graph ``state.step`` (0-based step being computed); for host faults
+      it is the 1-based count of completed steps; for "loader_error" the
+      batch index (0-based) whose fetch raises; for "ckpt_fail"/"ckpt_slow"
+      the first save call with ``step >= fault.step`` fires.
+    duration: consecutive steps poisoned ("nan_step") or seconds
+      ("ckpt_slow"/"hang" cap).
+    exc: exception type for "loader_error"/"ckpt_fail".
+    message: exception text.
+    """
+
+    kind: str
+    step: int
+    duration: float = 1
+    exc: type = OSError
+    message: str = "chaos: injected fault"
+    fired: bool = False
+
+
+class _ChaosLoader:
+    """DataLoader proxy that raises ``fault.exc`` before yielding batch N."""
+
+    def __init__(self, inner, fault: Fault, monkey: "ChaosMonkey"):
+        self._inner = inner
+        self._fault = fault
+        self._monkey = monkey
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        for i, batch in enumerate(iter(self._inner)):
+            f = self._fault
+            if not f.fired and i >= f.step:
+                self._monkey.record(f)
+                raise f.exc(f"{f.message} (loader batch {i})")
+            yield batch
+
+
+class _ChaosCheckpoint:
+    """CheckpointManager proxy: failing or slow ``save`` at a chosen step."""
+
+    def __init__(self, inner, faults: List[Fault], monkey: "ChaosMonkey"):
+        self._inner = inner
+        self._faults = faults
+        self._monkey = monkey
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def save(self, step: int, state, meta=None, force: bool = False):
+        for f in self._faults:
+            if f.fired or step < f.step:
+                continue
+            self._monkey.record(f)
+            if f.kind == "ckpt_fail":
+                raise f.exc(f"{f.message} (checkpoint save at step {step})")
+            log.warning("chaos: delaying checkpoint save %.1fs", f.duration)
+            time.sleep(f.duration)
+        return self._inner.save(step, state, meta=meta, force=force)
+
+
+class ChaosMonkey:
+    """Holds the fault plan and wires it into a Trainer's seams.
+
+    Reuse ONE monkey across supervisor restarts (pass it to every Trainer the
+    factory builds): fired faults stay fired, which is what lets a
+    fault-recover-complete scenario terminate.
+    """
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+        self.fired_log: List[str] = []
+
+    def record(self, fault: Fault) -> None:
+        fault.fired = True
+        entry = f"{fault.kind}@{fault.step}"
+        self.fired_log.append(entry)
+        log.warning("chaos: fired %s", entry)
+
+    def _of_kind(self, *kinds: str) -> List[Fault]:
+        return [f for f in self.faults if f.kind in kinds]
+
+    # -- trainer seams ------------------------------------------------------
+
+    def wrap_train_step(self, step_fn: Callable) -> Callable:
+        """In-graph NaN poison over ``state.step`` ∈ [step, step+duration)."""
+        windows = self._of_kind("nan_step")
+        if not windows:
+            return step_fn
+
+        def poisoned(state, batch, rng):
+            new_state, metrics = step_fn(state, batch, rng)
+            s = state.step
+            inside = jnp.zeros((), jnp.bool_)
+            for f in windows:
+                inside |= (s >= f.step) & (s < f.step + int(f.duration))
+            nanify = jnp.where(inside, jnp.float32(jnp.nan), jnp.float32(0.0))
+            metrics = dict(metrics)
+            metrics["loss"] = metrics["loss"] + nanify
+            metrics["grad_norm"] = metrics["grad_norm"] + nanify
+            # the update itself diverges too: without the guard these NaNs
+            # would land in params exactly like a real blow-up
+            new_params = jax.tree.map(
+                lambda p: p + nanify.astype(p.dtype), new_state.params
+            )
+            from zero_transformer_tpu.parallel.zero import TrainState
+
+            return (
+                TrainState(
+                    step=new_state.step,
+                    params=new_params,
+                    opt_state=new_state.opt_state,
+                ),
+                metrics,
+            )
+
+        return poisoned
+
+    def wrap_loader(self, loader):
+        faults = self._of_kind("loader_error")
+        if not faults:
+            return loader
+        if len(faults) > 1:
+            raise ValueError("one loader_error fault at a time")
+        return _ChaosLoader(loader, faults[0], self)
+
+    def wrap_checkpoint(self, ckpt):
+        faults = self._of_kind("ckpt_fail", "ckpt_slow")
+        if not faults:
+            return ckpt
+        return _ChaosCheckpoint(ckpt, faults, self)
+
+    def on_step(self, step: int) -> None:
+        """Host-side faults, called by the trainer after each completed step."""
+        for f in self._of_kind("sigterm", "hang"):
+            if f.fired or step < f.step:
+                continue
+            self.record(f)
+            if f.kind == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:
+                # interruptible busy-hang: short sleeps keep bytecode
+                # boundaries frequent so the watchdog's interrupt_main can
+                # land; the cap keeps a broken watchdog from deadlocking CI
+                deadline = time.monotonic() + float(f.duration)
+                while time.monotonic() < deadline:
+                    time.sleep(0.01)
+                log.error(
+                    "chaos: hang cap %.0fs elapsed without watchdog abort",
+                    float(f.duration),
+                )

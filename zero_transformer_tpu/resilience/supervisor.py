@@ -1,0 +1,234 @@
+"""Run supervisor: bounded in-process restarts with exponential backoff.
+
+The reference's recovery loop was a human rerunning ``python main_zero.py
+--resume`` (reference ``main_zero.py:48-52``). The supervisor is that loop as
+code: build a Trainer, run it, and on a *retryable* failure — loader/storage
+IO, transient XLA runtime errors, watchdog hangs, preemption — resume from
+the last good checkpoint after a backoff, up to a restart budget. Fatal
+errors (config/shape mistakes, anomaly-policy halts) propagate immediately:
+restarting cannot fix a wrong config, and retrying a deterministic divergence
+just burns the budget.
+
+Restartability leans on what the rest of the stack already guarantees:
+checkpoints are atomic step directories carrying loader position, resume
+fast-forwards the data stream, and the partitioned program is deterministic
+(GSPMD, arXiv:2105.04663) — so a restart lands exactly where the run left
+off. Each retry constructs a FRESH Trainer (fresh loader threads, fresh
+orbax manager): a failed run's half-broken host state is never reused.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, List, Optional
+
+from zero_transformer_tpu.config import Config
+from zero_transformer_tpu.resilience import AnomalyHalt, RetryableError
+
+log = logging.getLogger("zero_transformer_tpu")
+
+# Exception types that restarting can never fix. FileNotFoundError (an
+# OSError subclass) is fatal by position in this tuple: a missing config /
+# dataset / checkpoint root stays missing on retry.
+_FATAL_TYPES = (
+    AnomalyHalt,
+    ValueError,
+    TypeError,
+    KeyError,
+    AttributeError,
+    NotImplementedError,
+    FileNotFoundError,
+    IsADirectoryError,
+    PermissionError,
+)
+
+# Transient-failure fingerprints in foreign exception messages (XLA runtime
+# status codes, storage/network strings). Matched case-insensitively against
+# any exception not already classified by type.
+_RETRYABLE_PATTERNS = (
+    "resource_exhausted",
+    "deadline_exceeded",
+    "unavailable",
+    "data_loss",
+    "aborted",
+    "cancelled",
+    "connection",
+    "socket",
+    "timed out",
+    "timeout",
+    "preempt",
+    "temporarily",
+    "transient",
+    "too many requests",
+    "service unavailable",
+)
+
+
+def classify(exc: BaseException) -> str:
+    """``"retryable"`` | ``"fatal"`` for a train-loop exception.
+
+    Order matters: explicit ``RetryableError`` marks win over everything
+    (``HangError`` is a RuntimeError by ancestry but retryable by intent),
+    then the fatal type list, then OSError (storage/loader IO) and
+    message-fingerprint matching; anything unrecognized defaults to fatal —
+    blindly restarting an unknown bug risks an infinite crash loop that
+    *looks* like progress.
+    """
+    if isinstance(exc, RetryableError):
+        return "retryable"
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        return "fatal"
+    if isinstance(exc, _FATAL_TYPES):
+        return "fatal"
+    if isinstance(exc, (OSError, ConnectionError, TimeoutError)):
+        return "retryable"
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(pat in msg for pat in _RETRYABLE_PATTERNS):
+        return "retryable"
+    return "fatal"
+
+
+@dataclasses.dataclass
+class RestartRecord:
+    attempt: int
+    step: Optional[int]  # last known step when the attempt ended
+    reason: str
+    backoff_s: float
+
+
+class Supervisor:
+    """Run ``Trainer.train`` under bounded restarts (``train.py --supervise``).
+
+    Args:
+      cfg: run config; ``cfg.resilience`` supplies the restart budget and
+        backoff. After the first attempt, retries force ``checkpoint.resume``
+        so each restart picks up from the last good checkpoint.
+      trainer_factory: ``cfg -> Trainer``; defaults to the real Trainer.
+        Tests inject chaos-wrapped trainers here, keeping one ChaosMonkey
+        alive across restarts (a fault that fired stays fired).
+      use_wandb: forwarded to the default factory.
+      sleep_fn: injectable backoff sleep (tests pass a recorder).
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        trainer_factory: Optional[Callable[[Config], "object"]] = None,
+        use_wandb: bool = False,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ):
+        self.cfg = cfg
+        self.res = cfg.resilience
+        self.use_wandb = use_wandb
+        self.sleep_fn = sleep_fn
+        self.history: List[RestartRecord] = []
+        if trainer_factory is None:
+
+            def trainer_factory(run_cfg: Config):
+                from zero_transformer_tpu.training.trainer import Trainer
+
+                return Trainer(run_cfg, use_wandb=self.use_wandb)
+
+        self.trainer_factory = trainer_factory
+
+    def _backoff(self, attempt: int) -> float:
+        return min(
+            self.res.backoff_base_s * (2.0 ** (attempt - 1)), self.res.backoff_max_s
+        )
+
+    def _resumed_cfg(self, attempt: int) -> Config:
+        if attempt == 0 or self.cfg.checkpoint.resume:
+            return self.cfg
+        return dataclasses.replace(
+            self.cfg,
+            checkpoint=dataclasses.replace(self.cfg.checkpoint, resume=True),
+        )
+
+    def run(self, max_steps: Optional[int] = None):
+        """Train to completion or exhaust the restart budget.
+
+        Returns the final TrainState. A clean-but-early exit (SIGTERM
+        preemption breaks the loop after a force-save) is resumed like a
+        retryable failure: in-process the distinction does not matter, and
+        if the platform really is about to kill the process the checkpoint
+        is already on disk either way.
+        """
+        attempt = 0
+        target: Optional[int] = None  # fixed step target once max_steps known
+        while True:
+            trainer = None
+            step: Optional[int] = None
+            try:
+                # construction is inside the try: it touches storage
+                # (checkpoint ensure_ready, loader opens), which fails
+                # transiently on pods just like the loop does
+                trainer = self.trainer_factory(self._resumed_cfg(attempt))
+                run_max = max_steps
+                if max_steps is not None:
+                    # max_steps is a budget for the WHOLE supervised run, not
+                    # per attempt: pin the absolute target on the first
+                    # attempt and hand each retry only the remainder (a
+                    # restart resumes from the latest checkpoint, which is
+                    # where Trainer.train will restart counting from)
+                    resumed_at = (
+                        trainer.ckpt.latest_step() or 0
+                        if self._resumed_cfg(attempt).checkpoint.resume
+                        else 0
+                    )
+                    if target is None:
+                        target = resumed_at + max_steps
+                    run_max = target - resumed_at
+                    if run_max <= 0:
+                        # preempted exactly at the target: budget spent
+                        # (0 is falsy to Trainer.train and would mean
+                        # "run to total_steps")
+                        log.info(
+                            "supervisor: step target %d already reached", target
+                        )
+                        return trainer.init_state()
+                state = trainer.train(max_steps=run_max)
+                step = int(state.step)
+                if not getattr(trainer, "preempted", False):
+                    if attempt:
+                        log.info(
+                            "supervisor: run completed at step %d after %d "
+                            "restart(s)", step, attempt,
+                        )
+                    return state
+                reason = f"preempted at step {step}"
+            except BaseException as e:
+                kind = classify(e)
+                if trainer is not None:
+                    step = getattr(trainer, "last_step", None)
+                if kind == "fatal":
+                    log.error(
+                        "supervisor: fatal %s at step %s — not restarting: %s",
+                        type(e).__name__, step, e,
+                    )
+                    raise
+                reason = f"{type(e).__name__}: {e}"
+            finally:
+                if trainer is not None:
+                    try:
+                        trainer.close()
+                    except Exception:
+                        log.exception(
+                            "supervisor: trainer.close() failed (ignored)"
+                        )
+
+            attempt += 1
+            if attempt > self.res.max_restarts:
+                raise RetryableError(
+                    f"restart budget exhausted ({self.res.max_restarts}); "
+                    f"last failure: {reason}"
+                )
+            delay = self._backoff(attempt)
+            self.history.append(
+                RestartRecord(attempt=attempt, step=step, reason=reason, backoff_s=delay)
+            )
+            log.warning(
+                "supervisor: restart %d/%d in %.1fs (%s)",
+                attempt, self.res.max_restarts, delay, reason,
+            )
+            self.sleep_fn(delay)

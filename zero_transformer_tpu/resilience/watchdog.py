@@ -1,0 +1,136 @@
+"""Hang watchdog: a heartbeat deadline on the train loop.
+
+A wedged collective, a deadlocked host thread, or a storage stall presents as
+the same symptom — the loop stops completing steps — and on a pod it burns
+reserved chips silently until a human notices. The watchdog turns that into
+a bounded, diagnosable, *retryable* failure:
+
+- the train loop touches ``beat()`` once per step (a monotonic-clock store,
+  no locks, no device work);
+- a daemon thread checks the deadline; on expiry it (1) dumps every Python
+  thread's stack plus live-device-array stats to the log — the forensic
+  snapshot a post-mortem needs, (2) runs the caller's ``on_hang`` hook
+  (the trainer force-saves a checkpoint there, best-effort), and
+  (3) aborts the main thread via ``_thread.interrupt_main()``;
+- the trainer translates the resulting ``KeyboardInterrupt`` into
+  ``HangError`` — a ``RetryableError`` — so ``--supervise`` restarts the run
+  from the checkpoint the hook just wrote.
+
+``interrupt_main`` only lands between Python bytecodes: it reliably breaks
+host-side stalls (loader deadlock, storage retry loop, a stuck ``sleep``
+loop) but cannot preempt a single blocking C call such as a wedged XLA
+execute — there the stack dump still fires and an external supervisor (the
+pod scheduler's own liveness probe) must kill the process. That split is
+exactly the design: everything recoverable in-process is recovered
+in-process, and everything else at least dies loudly with stacks on disk.
+"""
+from __future__ import annotations
+
+import _thread
+import logging
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+log = logging.getLogger("zero_transformer_tpu")
+
+
+def dump_stacks(reason: str = "watchdog") -> str:
+    """Format every live thread's Python stack + live-array stats, and log it."""
+    lines = [f"=== {reason}: thread stacks ==="]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        total = sum(a.size * a.dtype.itemsize for a in arrays)
+        lines.append(
+            f"--- live device arrays: {len(arrays)}, "
+            f"{total / 1e9:.3f} GB (logical) ---"
+        )
+    except Exception as e:  # diagnostics must never mask the hang itself
+        lines.append(f"--- live-array stats unavailable: {e!r} ---")
+    text = "\n".join(lines)
+    log.error("%s", text)
+    return text
+
+
+class Watchdog:
+    """Deadline thread over a heartbeat the owner touches each step."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_hang: Optional[Callable[[], None]] = None,
+        poll_s: Optional[float] = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError("watchdog timeout must be > 0 (0 disables upstream)")
+        self.timeout_s = timeout_s
+        self.on_hang = on_hang
+        self.poll_s = poll_s if poll_s is not None else min(timeout_s / 4.0, 1.0)
+        self.fired = False
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    def start(self) -> "Watchdog":
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name="zt-watchdog"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_s * 4 + 1.0)
+            self._thread = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            stalled = time.monotonic() - self._last_beat
+            if stalled <= self.timeout_s:
+                continue
+            self.fired = True
+            log.error(
+                "watchdog: no heartbeat for %.1fs (deadline %.1fs) — "
+                "dumping stacks, force-saving, aborting retryably",
+                stalled,
+                self.timeout_s,
+            )
+            dump_stacks("watchdog deadline expired")
+            if self.on_hang is not None:
+                # side thread with a bounded join: the hook (a checkpoint
+                # force-save) may itself hang on the very storage stall that
+                # triggered the watchdog, and the ABORT must never depend on
+                # the hook finishing
+                hook = threading.Thread(
+                    target=self._run_hook, daemon=True, name="zt-watchdog-hook"
+                )
+                hook.start()
+                hook.join(timeout=self.timeout_s)
+                if hook.is_alive():
+                    log.error(
+                        "watchdog: on_hang hook still running after %.1fs — "
+                        "aborting without it", self.timeout_s,
+                    )
+            # lands as KeyboardInterrupt in the main thread at the next
+            # bytecode boundary; the trainer re-raises it as HangError
+            _thread.interrupt_main()
+            return
+
+    def _run_hook(self) -> None:
+        try:
+            self.on_hang()
+        except Exception:
+            log.exception("watchdog on_hang hook failed (continuing abort)")

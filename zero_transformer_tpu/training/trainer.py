@@ -34,6 +34,7 @@ from zero_transformer_tpu.parallel.zero import (
 )
 from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
 from zero_transformer_tpu.utils import monitoring
+from zero_transformer_tpu.utils.jax_compat import ensure_donatable
 
 log = logging.getLogger("zero_transformer_tpu")
 
@@ -161,6 +162,7 @@ class Trainer:
         train_loader: Optional[DataLoader] = None,
         val_loader: Optional[DataLoader] = None,
         use_wandb: bool = False,
+        chaos=None,
     ):
         self.cfg = cfg
         build = build_training(cfg, mesh=mesh)
@@ -190,6 +192,22 @@ class Trainer:
         # fail fast on a bad checkpoint destination (wrong bucket, perms)
         # before any compute is spent — the manager is otherwise lazy
         self.ckpt.ensure_ready()
+        # chaos injection (tests/test_resilience.py): wrap the fault seams —
+        # step function, loader, checkpoint manager — before anything
+        # compiles against them. None in production runs.
+        self._chaos = chaos
+        if chaos is not None:
+            self.train_step = chaos.wrap_train_step(self.train_step)
+            self.train_loader = chaos.wrap_loader(self.train_loader)
+            self.ckpt = chaos.wrap_checkpoint(self.ckpt)
+        # anomaly-guard wrap cache, keyed on the identity of the step
+        # function it wrapped (tests monkeypatch self.train_step; the guard
+        # must wrap whatever is current at train() time, once)
+        self._guard_cache: Optional[tuple] = None
+        # supervisor-facing run status
+        self.preempted = False
+        self.last_step: Optional[int] = None
+        self.resilience_report: Dict[str, Any] = {}
         from zero_transformer_tpu.config import flatten_config
 
         self.metrics = monitoring.MetricsLogger(
@@ -229,6 +247,10 @@ class Trainer:
         ck = self.cfg.checkpoint
         if ck.resume and self.ckpt.latest_step() is not None:
             state, meta = self.ckpt.restore(self.abstract_state())
+            # restored buffers may be zero-copy views the runtime does not
+            # own; the train step donates this state, so force ownership
+            # before it ever reaches a donating jit (utils/jax_compat.py)
+            state = ensure_donatable(state)
             step = int(state.step)
             loader_state = (meta or {}).get("loader")
             if loader_state:
@@ -241,7 +263,9 @@ class Trainer:
                 self.model, self.tx, self.rng, self.mesh, self.sample_shape, self.plan
             )
             if ck.warm_init and ck.warm_init_msgpack:
-                params = self._warm_params_from_msgpack(ck.warm_init_msgpack)
+                params = ensure_donatable(
+                    self._warm_params_from_msgpack(ck.warm_init_msgpack)
+                )
                 state = TrainState(
                     step=state.step, params=params, opt_state=state.opt_state
                 )
@@ -249,7 +273,7 @@ class Trainer:
             elif ck.warm_init and ck.warm_init_dir:
                 donor = ckpt_lib.CheckpointManager(ck.warm_init_dir, keep=1)
                 abstract = self.abstract_state()
-                params = donor.restore_params(abstract.params)
+                params = ensure_donatable(donor.restore_params(abstract.params))
                 state = TrainState(
                     step=state.step, params=params, opt_state=state.opt_state
                 )
@@ -348,8 +372,56 @@ class Trainer:
         signal.signal(signal.SIGTERM, handler)
         return flag, lambda: signal.signal(signal.SIGTERM, previous)
 
+    # -- resilience plumbing ------------------------------------------------
+
+    def _guarded_step(self):
+        """(guard, wrapped_step) for the CURRENT ``self.train_step`` — cached
+        so repeated ``train()`` calls reuse the compiled wrapper, but rebuilt
+        if the step function was swapped (tests monkeypatch it)."""
+        from zero_transformer_tpu.resilience.anomaly import AnomalyGuard
+
+        cache = self._guard_cache
+        if cache is None or cache[0] is not self.train_step:
+            guard = AnomalyGuard(
+                self.cfg.resilience, self.mesh, self.plan, self.batch_sharding
+            )
+            self._guard_cache = (
+                self.train_step, guard, guard.wrap(self.train_step)
+            )
+        return self._guard_cache[1], self._guard_cache[2]
+
+    def _hang_force_save(self):
+        """Watchdog ``on_hang`` hook: best-effort checkpoint of the last
+        COMPLETED step's state, from the watchdog thread, so the supervisor's
+        restart resumes at the hang point instead of the last periodic save.
+        (With a host-side hang the device state is intact; with a wedged
+        device this save itself may hang — it runs after the stack dump, and
+        the abort does not depend on it.)"""
+        live = getattr(self, "_live", None)
+        if live is None:
+            return
+        step, state = live
+        try:
+            self.ckpt.save(
+                step, state, meta={"loader": self.train_loader.state()}, force=True
+            )
+            self.ckpt.wait()
+            log.warning("watchdog: force-saved checkpoint at step %d", step)
+        except Exception:
+            log.exception("watchdog: force-save failed (restart will use the "
+                          "last periodic checkpoint)")
+
+    def _data_fault_payload(self) -> Dict[str, float]:
+        """Loader fault counters (skipped shards/members, retries) for the
+        metrics stream — a pod run must SHOW the data it silently skipped."""
+        counters = getattr(self.train_loader, "fault_counters", None)
+        if counters is None:
+            return {}
+        return {f"data_{k}": float(v) for k, v in counters().items() if v}
+
     def train(self, max_steps: Optional[int] = None) -> TrainState:
         cfg = self.cfg.training
+        res = self.cfg.resilience
         state = self.state if self.state is not None else self.init_state()
         start = int(state.step)
         end = min(cfg.total_steps, start + max_steps) if max_steps else cfg.total_steps
@@ -365,81 +437,179 @@ class Trainer:
         profile_stop = start + 1 + cfg.profile_steps if cfg.profile_steps else None
         profiling = False
 
+        # anomaly guard: in-graph detect-and-drop with a device-resident
+        # carry; the host reads it only at log points (no per-step sync)
+        guard = carry = None
+        step_fn = self.train_step
+        if res.anomaly_detection:
+            guard, step_fn = self._guarded_step()
+            carry = guard.init_carry()
+        anom_seen = 0
+        rollbacks = 0
+        snapshot = None
+        last_snap_step = start
+        if guard is not None and res.anomaly_response == "rollback":
+            from zero_transformer_tpu.resilience.anomaly import HostSnapshot
+
+            snapshot = HostSnapshot()
+            snapshot.capture(state)  # rollback target exists from step one
+        watchdog = None
+        if res.watchdog_timeout_s > 0:
+            from zero_transformer_tpu.resilience.watchdog import Watchdog
+
+            # armed AFTER the first step completes: step one legitimately
+            # blocks for the whole XLA compile, which would need its own
+            # (huge) deadline — the heartbeat contract is for steady state
+            watchdog = Watchdog(
+                res.watchdog_timeout_s, on_hang=self._hang_force_save
+            )
+        self.preempted = False
+        self.last_step = start
+        self.resilience_report = {"anomalies": 0, "rollbacks": 0,
+                                  "watchdog_fired": False}
+
         step = start
         tick_step = start  # step at which the timing window last restarted
-        while step < end:
-            if profile_stop and not profiling and step == start + 1:
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-                log.info("profiler: tracing %d steps to %s", cfg.profile_steps, profile_dir)
-            local = next(it)
-            batch = device_put_batch(local, self.batch_sharding)
-            state, metrics = self.train_step(state, batch, self.rng)
-            step += 1
-            if profiling and step >= profile_stop:
-                jax.block_until_ready(metrics["loss"])
+        try:
+            while step < end:
+                if profile_stop and not profiling and step == start + 1:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                    log.info("profiler: tracing %d steps to %s", cfg.profile_steps, profile_dir)
+                local = next(it)
+                batch = device_put_batch(local, self.batch_sharding)
+                if guard is not None:
+                    state, metrics, carry = step_fn(state, batch, self.rng, carry)
+                else:
+                    state, metrics = step_fn(state, batch, self.rng)
+                step += 1
+                self.last_step = step
+                self._live = (step, state)
+                if watchdog is not None:
+                    if step == start + 1:
+                        watchdog.start()
+                    watchdog.beat()
+                if profiling and step >= profile_stop:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
+
+                paused = False
+                if step % cfg.log_frequency == 0 or step == end:
+                    loss = float(metrics["loss"])  # device sync point
+                    if (
+                        cfg.halt_on_nan
+                        and not jnp.isfinite(loss)
+                        and (guard is None or res.anomaly_response == "halt")
+                    ):
+                        # Without the guard this state is post-divergence (the
+                        # NaN update already landed) — deliberately NOT saved,
+                        # or it would bury the last GOOD checkpoint. With the
+                        # guard the update was dropped in-graph, but 'halt'
+                        # still means halt: surface it, don't train through.
+                        good = self.ckpt.latest_step()
+                        poisoned = (
+                            "update was dropped in-graph (params still clean)"
+                            if guard is not None
+                            else "NOT checkpointed (state is already poisoned)"
+                        )
+                        raise RuntimeError(
+                            f"non-finite loss {loss} at step {step}; {poisoned} "
+                            f"— resume from step {good} and rerun with "
+                            f"--debug-nans to find the source op"
+                        )
+                    dt = timer.tick()
+                    payload = {
+                        "loss": loss,
+                        "perplexity": float(jnp.exp(jnp.minimum(jnp.float32(loss), 20.0))),
+                        "grad_norm": float(metrics["grad_norm"]),
+                        "learning_rate": float(metrics.get("learning_rate", 0.0)),
+                        "tokens_seen": float(step) * tokens_per_step,
+                        "seq_len": cfg.train_context,
+                    }
+                    if dt and step > tick_step:
+                        per_step = dt / (step - tick_step)
+                        tok_s = tokens_per_step / per_step
+                        payload["tokens_per_sec"] = tok_s
+                        payload["step_time_s"] = per_step
+                        util = monitoring.mfu(tok_s / n_chips, self.flops_per_token)
+                        if util is not None:
+                            payload["mfu"] = util
+                    hbm = monitoring.hbm_used_gb()
+                    if hbm is not None:
+                        payload["hbm_gb"] = hbm
+                    payload.update(self._data_fault_payload())
+                    if guard is not None:
+                        stats = guard.read(carry)  # host sync — log points only
+                        new_anoms = stats.count - anom_seen
+                        if new_anoms > 0:
+                            # run-level total survives carry resets (rollback)
+                            self.resilience_report["anomalies"] += new_anoms
+                        if self.resilience_report["anomalies"]:
+                            payload["anomalies_total"] = (
+                                self.resilience_report["anomalies"]
+                            )
+                            payload["anomaly_streak"] = stats.streak
+                    self.metrics.log(payload, step, prefix="train")
+                    tick_step = step
+                    if guard is not None:
+                        state, carry, did_roll = self._handle_anomalies(
+                            stats, new_anoms, state, carry, guard, snapshot,
+                            rollbacks, step,
+                        )
+                        anom_seen = 0 if did_roll else stats.count
+                        if did_roll:
+                            rollbacks += 1
+                            self.resilience_report["rollbacks"] = rollbacks
+                            paused = True  # exclude rollback time from timing
+                        # mirror a known-good state to host RAM on schedule
+                        if (
+                            snapshot is not None
+                            and stats.streak == 0
+                            and not did_roll
+                            and step - last_snap_step >= res.snapshot_frequency
+                        ):
+                            snapshot.capture(state)
+                            last_snap_step = step
+
+                if cfg.evaluation_frequency and step % cfg.evaluation_frequency == 0:
+                    self.metrics.log(self.evaluate(state), step, prefix="validation")
+                    paused = True
+
+                if self.ckpt.save(step, state, meta={"loader": self.train_loader.state()}):
+                    paused = True
+                if paused:
+                    # exclude eval/checkpoint wall time from the throughput window
+                    timer.tick()
+                    tick_step = step
+
+                if self._chaos is not None:
+                    self._chaos.on_step(step)
+                if preempted.is_set():
+                    log.warning("preemption: saving at step %d and stopping", step)
+                    self.metrics.event("preemption", step)
+                    self.preempted = True
+                    break
+        except KeyboardInterrupt:
+            if watchdog is not None and watchdog.fired:
+                from zero_transformer_tpu.resilience import HangError
+
+                self.resilience_report["watchdog_fired"] = True
+                self.metrics.event(
+                    "watchdog_abort", step, timeout_s=res.watchdog_timeout_s
+                )
+                raise HangError(
+                    f"train loop produced no step for more than "
+                    f"{res.watchdog_timeout_s}s (hung around step {step}); "
+                    f"stacks dumped, checkpoint force-saved — restartable"
+                ) from None
+            raise
+        finally:
+            if profiling:
                 jax.profiler.stop_trace()
-                profiling = False
-
-            if step % cfg.log_frequency == 0 or step == end:
-                loss = float(metrics["loss"])  # device sync point
-                if cfg.halt_on_nan and not jnp.isfinite(loss):
-                    # deliberately NOT saving: this state is post-divergence
-                    # (NaN already written into params/opt by the update);
-                    # saving it would bury the last GOOD checkpoint that
-                    # --resume restores from
-                    if profiling:
-                        jax.profiler.stop_trace()
-                    restore_handler()
-                    good = self.ckpt.latest_step()
-                    raise RuntimeError(
-                        f"non-finite loss {loss} at step {step}; NOT "
-                        f"checkpointed (state is already poisoned) — resume "
-                        f"from step {good} and rerun with --debug-nans to "
-                        f"find the source op"
-                    )
-                dt = timer.tick()
-                payload = {
-                    "loss": loss,
-                    "perplexity": float(jnp.exp(jnp.minimum(jnp.float32(loss), 20.0))),
-                    "grad_norm": float(metrics["grad_norm"]),
-                    "learning_rate": float(metrics.get("learning_rate", 0.0)),
-                    "tokens_seen": float(step) * tokens_per_step,
-                    "seq_len": cfg.train_context,
-                }
-                if dt and step > tick_step:
-                    per_step = dt / (step - tick_step)
-                    tok_s = tokens_per_step / per_step
-                    payload["tokens_per_sec"] = tok_s
-                    payload["step_time_s"] = per_step
-                    util = monitoring.mfu(tok_s / n_chips, self.flops_per_token)
-                    if util is not None:
-                        payload["mfu"] = util
-                hbm = monitoring.hbm_used_gb()
-                if hbm is not None:
-                    payload["hbm_gb"] = hbm
-                self.metrics.log(payload, step, prefix="train")
-                tick_step = step
-
-            paused = False
-            if cfg.evaluation_frequency and step % cfg.evaluation_frequency == 0:
-                self.metrics.log(self.evaluate(state), step, prefix="validation")
-                paused = True
-
-            if self.ckpt.save(step, state, meta={"loader": self.train_loader.state()}):
-                paused = True
-            if paused:
-                # exclude eval/checkpoint wall time from the throughput window
-                timer.tick()
-                tick_step = step
-
-            if preempted.is_set():
-                log.warning("preemption: saving at step %d and stopping", step)
-                break
-
-        if profiling:
-            jax.profiler.stop_trace()
-        restore_handler()
+            if watchdog is not None:
+                watchdog.stop()
+            restore_handler()
         if self.ckpt.latest_step() != step:
             self.ckpt.save(
                 step, state, meta={"loader": self.train_loader.state()}, force=True
@@ -447,6 +617,76 @@ class Trainer:
         self.ckpt.wait()
         self.state = state
         return state
+
+    def _handle_anomalies(
+        self, stats, new, state, carry, guard, snapshot, rollbacks, step
+    ):
+        """Host-side escalation from the guard carry, at a log point.
+
+        The in-graph guard already DROPPED every flagged update (skip_batch
+        is the floor, not a choice); what remains is whether to keep going,
+        roll back, or stop. Returns (state, carry, did_rollback)."""
+        res = self.cfg.resilience
+        if new <= 0:
+            return state, carry, False
+        good = self.ckpt.latest_step()
+        log.warning(
+            "anomaly guard: %d flagged step(s) since last check "
+            "(streak %d, total %d) — updates dropped in-graph",
+            new, stats.streak, stats.count,
+        )
+        from zero_transformer_tpu.resilience import AnomalyHalt
+
+        if res.anomaly_response == "halt":
+            raise AnomalyHalt(
+                f"anomaly policy 'halt': {new} flagged step(s) by step {step} "
+                f"(non-finite loss/grad or spike; streak {stats.streak}). "
+                f"Updates were dropped in-graph; resume from step {good} "
+                f"after inspecting the data window / lowering the LR"
+            )
+        if (
+            res.anomaly_response == "rollback"
+            and stats.streak >= res.rollback_after
+            and snapshot is not None
+            and snapshot.captured
+        ):
+            if rollbacks >= res.max_rollbacks:
+                raise AnomalyHalt(
+                    f"rollback budget exhausted ({res.max_rollbacks}) with the "
+                    f"anomaly streak still at {stats.streak} at step {step} — "
+                    f"this divergence is persistent; resume from step {good} "
+                    f"with a changed config"
+                )
+            from zero_transformer_tpu.parallel.zero import TrainState as TS
+
+            restored = snapshot.restore()
+            # keep the CURRENT step counter: the loader (and the schedule)
+            # move forward past the offending window — replaying the same
+            # batches into the same state would just diverge again
+            state = TS(
+                step=state.step,
+                params=restored.params,
+                opt_state=restored.opt_state,
+            )
+            carry = guard.init_carry()
+            log.warning(
+                "anomaly rollback %d/%d: restored host snapshot of step %d "
+                "at step %d (loader continues forward)",
+                rollbacks + 1, res.max_rollbacks, snapshot.step, step,
+            )
+            self.metrics.event(
+                "anomaly_rollback", step,
+                to_step=snapshot.step, streak=stats.streak,
+                rollback=rollbacks + 1,
+            )
+            return state, carry, True
+        if stats.streak >= res.max_consecutive_anomalies:
+            raise AnomalyHalt(
+                f"{stats.streak} consecutive anomalous steps at step {step}: "
+                f"every update is being dropped — no training progress is "
+                f"possible; resume from step {good} with a changed config"
+            )
+        return state, carry, False
 
     def close(self) -> None:
         self.ckpt.close()

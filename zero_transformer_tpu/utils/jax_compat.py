@@ -98,3 +98,23 @@ else:
 
     def get_abstract_mesh():
         return None
+
+
+def ensure_donatable(tree):
+    """Copy every leaf into an XLA-runtime-owned buffer (eager add-0).
+
+    ``jax.device_put`` from host numpy and orbax restores can hand back
+    arrays whose buffers the runtime does NOT own (zero-copy views of host
+    memory). The train step donates its input state, and on jax 0.4.37's
+    CPU backend donating such a foreign buffer lets XLA recycle memory it
+    never owned — the state silently turns to garbage within a step or two
+    and glibc aborts with heap corruption. An eager add-0 per leaf runs a
+    real XLA computation, so every output buffer is freshly allocated and
+    runtime-owned (shardings are preserved: eager ops follow their committed
+    operands). Call this on ANY state that flows into a donating jit from
+    outside one: checkpoint restores, host-RAM rollback snapshots, warm-init
+    imports.
+    """
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda x: jnp.add(x, jnp.zeros((), x.dtype)), tree)

@@ -133,6 +133,28 @@ class MetricsLogger:
         )
         print(f"[step {step}] {parts}", flush=True)
 
+    def event(self, name: str, step: int, **fields: Any) -> None:
+        """One-off run event (anomaly rollback, supervisor restart, watchdog
+        abort, skipped data shard) — lands in the same JSONL/wandb stream as
+        the scalar metrics so a post-mortem reads ONE timeline, but tagged
+        with ``event`` so dashboards can render it as an annotation instead
+        of a curve."""
+        if not self.enabled:
+            return
+        clean = {
+            k: (float(v) if hasattr(v, "item") else v) for k, v in fields.items()
+        }
+        if self._file:
+            self._file.write(
+                json.dumps({"step": step, "event": name, **clean}) + "\n"
+            )
+        if self._wandb:
+            self._wandb.log(
+                {f"event/{name}/{k}": v for k, v in clean.items()}, step=step
+            )
+        parts = " ".join(f"{k}={v}" for k, v in clean.items())
+        print(f"[step {step}] EVENT {name} {parts}", flush=True)
+
     def close(self) -> None:
         if self._file:
             self._file.close()
