@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full chaos serve-chaos bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -24,6 +24,13 @@ test-full:
 # fast resilience cases are UN-marked and already run in the quick lane.
 chaos:
 	$(PY) -m pytest tests/test_resilience.py -q -m chaos $(PYTEST_ARGS)
+
+# Serving fault-injection lane: the full chaos scenario over the HTTP
+# server (decode faults + NaN-logit windows + mid-load SIGTERM -> graceful
+# drain, untouched requests byte-identical). The fast deterministic serving
+# resilience cases are un-marked and run in the quick lane.
+serve-chaos:
+	$(PY) -m pytest tests/test_serving_resilience.py -q -m chaos $(PYTEST_ARGS)
 
 # One-line JSON benchmark artifact (driver contract).
 bench:

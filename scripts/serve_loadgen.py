@@ -53,6 +53,18 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0, help="base request seed")
     p.add_argument("--no-verify", action="store_true",
                    help="skip the per-request generate() parity check")
+    p.add_argument("--chaos", action="store_true",
+                   help="inject serving faults into the measured run (a "
+                        "decode-tick fault window + a NaN-logit window): "
+                        "faulted requests must fail RETRYABLY, untouched "
+                        "requests must still match generate() byte-for-byte")
+    p.add_argument("--chaos-tick", type=int, default=6,
+                   help="tick index of the injected decode fault")
+    p.add_argument("--chaos-nan-tick", type=int, default=10,
+                   help="tick index of the injected NaN-logit window (slot 0)")
+    p.add_argument("--drain-deadline", type=float, default=30.0,
+                   help="graceful-drain budget at end of run (the measured "
+                        "drain latency lands in the artifact)")
     p.add_argument("--out", default=str(REPO / "BENCH_serve.json"))
     return p.parse_args(argv)
 
@@ -86,13 +98,26 @@ def build(args):
     sampling = SamplingConfig(temperature=0.9, top_k=20)
     cache_len = args.cache_len or cfg.max_seq_len
 
-    def engine():
+    def engine(chaos=None):
         return ServingEngine(
             cfg, params, n_slots=args.slots, cache_len=cache_len,
-            sampling=sampling, max_queue=args.max_queue,
+            sampling=sampling, max_queue=args.max_queue, chaos=chaos,
         )
 
     return cfg, params, sampling, cache_len, engine
+
+
+def chaos_plan(args):
+    """Deterministic serving fault plan for --chaos: one decode-tick fault
+    (fails whatever is in a slot on that tick, retryably) and one NaN-logit
+    window on slot 0 (the per-tick guard must retire ONLY that slot)."""
+    from zero_transformer_tpu.serving import ServeFault, ServingChaosMonkey
+
+    return ServingChaosMonkey([
+        ServeFault("tick_fault", step=args.chaos_tick, duration=1),
+        ServeFault("nan_logits", step=args.chaos_nan_tick, duration=1,
+                   slots=[0]),
+    ])
 
 
 def reference_outputs(cfg, params, sampling, cache_len, requests, max_new):
@@ -156,7 +181,12 @@ def run_load(engine, requests, args):
             for w in workers:
                 w.join(timeout=600)
     finally:
-        stop.set()
+        # end-of-run graceful drain (instead of a bare stop): measures the
+        # drain latency the artifact reports, and proves the lifecycle
+        # reaches STOPPED with nothing in flight
+        engine.begin_drain(deadline_s=args.drain_deadline)
+        scheduler.join(timeout=args.drain_deadline + 30)
+        stop.set()  # fallback: a wedged drain still stops the loop
         scheduler.join(timeout=30)
     return handles, time.monotonic() - started
 
@@ -192,19 +222,31 @@ def main(argv=None) -> dict:
         warm.submit(prompt, max_new_tokens=args.max_new_tokens, seed=seed)
     warm.run_until_idle()
 
-    engine = make_engine()
+    engine = make_engine(chaos_plan(args) if args.chaos else None)
     handles, wall = run_load(engine, requests, args)
 
-    dropped = sum(1 for h in handles if h is None or h.status != "done")
+    terminal = ("done", "cancelled", "expired", "rejected", "failed")
+    # dropped = HUNG (no terminal event) — the acceptance bar's "no in-flight
+    # request hangs". Chaos-faulted requests fail retryably; they are errors,
+    # not drops.
+    dropped = sum(1 for h in handles if h is None or h.status not in terminal)
+    errors = sum(1 for h in handles if h is not None and h.status == "failed")
+    # non-chaos runs demand every request COMPLETE; chaos runs only demand
+    # terminal states (faulted requests fail retryably by design)
+    incomplete = sum(1 for h in handles if h is None or h.status != "done")
     mismatches = 0
     if refs is not None:
+        # byte-identical contract, measured over requests a fault did NOT
+        # touch: every completed request must match single-request
+        # generate() even when its neighbors were faulted mid-run
         mismatches = sum(
             1
             for h, ref in zip(handles, refs)
-            if h is None or h.tokens != ref
+            if h is not None and h.status == "done" and h.tokens != ref
         )
     tokens_out = sum(len(h.tokens) for h in handles if h is not None)
     snap = engine.metrics_snapshot()
+    shed = snap["shed_infeasible"] + snap["rejected_draining"]
 
     artifact = {
         "metric": f"serve_tokens_per_sec_{args.model}",
@@ -226,14 +268,30 @@ def main(argv=None) -> dict:
         "dropped": dropped,
         "verified": refs is not None,
         "mismatches": mismatches,
+        "chaos": bool(args.chaos),
+        "errors": errors,
+        "error_rate": round(errors / max(1, args.requests), 4),
+        "shed": shed,
+        "shed_rate": round(shed / max(1, args.requests), 4),
+        "drain_latency_s": round(engine.drain_latency_s or 0.0, 4),
+        "tick_faults": snap["tick_faults"],
+        "poisoned_slots": snap["poisoned_slots"],
+        "breaker_trips": snap["breaker_trips"],
+        "final_state": snap["state"],
         "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     Path(args.out).write_text(json.dumps(artifact, indent=2) + "\n")
     print(json.dumps(artifact))
-    if dropped or mismatches:
+    if dropped or mismatches or (incomplete and not args.chaos):
         raise SystemExit(
-            f"LOAD RUN FAILED: {dropped} dropped, {mismatches} garbled "
-            f"(vs generate() baseline) of {args.requests}"
+            f"LOAD RUN FAILED: {dropped} dropped (hung), {incomplete} "
+            f"incomplete, {mismatches} garbled (vs generate() baseline) of "
+            f"{args.requests}"
+        )
+    if args.chaos and artifact["final_state"] != "stopped":
+        raise SystemExit(
+            f"CHAOS RUN FAILED: engine did not drain (state "
+            f"{artifact['final_state']})"
         )
     return artifact
 

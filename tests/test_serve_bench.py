@@ -17,6 +17,11 @@ REQUIRED_KEYS = {
     "max_new_tokens", "wall_s", "ttft_ms", "itl_ms", "peak_occupancy",
     "peak_queue_depth", "completed", "rejected", "dropped", "verified",
     "mismatches", "measured_at_utc",
+    # resilience evidence (ISSUE 3): fault/shed/drain behavior is part of
+    # the load-run contract, chaos or not
+    "chaos", "errors", "error_rate", "shed", "shed_rate",
+    "drain_latency_s", "tick_faults", "poisoned_slots", "breaker_trips",
+    "final_state",
 }
 
 
@@ -57,6 +62,31 @@ def test_loadgen_artifact_schema_and_invariants(tmp_path):
     # 6 concurrent clients against 2 slots must saturate the engine
     assert artifact["peak_occupancy"] == 2
     assert artifact["peak_queue_depth"] >= 1
+    # an undisturbed run ends with a clean graceful drain and zero faults
+    assert artifact["chaos"] is False and artifact["errors"] == 0
+    assert artifact["final_state"] == "stopped"
+    assert artifact["drain_latency_s"] >= 0
+
+
+def test_loadgen_chaos_run_fails_retryably_and_drains(tmp_path):
+    """--chaos: the injected decode fault + NaN-logit window fail SOME
+    requests (retryably), hang none, garble none of the survivors (every
+    completed request stays byte-identical to generate()), and the engine
+    still drains to STOPPED — the quick-lane slice of the serving chaos
+    acceptance bar."""
+    loadgen = _load()
+    out = tmp_path / "BENCH_serve_chaos.json"
+    artifact = loadgen.main([
+        "--requests", "6", "--slots", "2", "--concurrency", "6",
+        "--max-new-tokens", "8", "--chaos", "--out", str(out),
+    ])
+    assert artifact["chaos"] is True
+    assert artifact["errors"] > 0  # the faults really fired
+    assert artifact["tick_faults"] >= 1 and artifact["poisoned_slots"] >= 1
+    assert artifact["dropped"] == 0  # no request hung: all reached terminal
+    assert artifact["mismatches"] == 0  # survivors byte-identical
+    assert artifact["completed"] + artifact["errors"] == 6
+    assert artifact["final_state"] == "stopped"
 
 
 def test_loadgen_request_mix_is_deterministic():

@@ -20,6 +20,12 @@ full fault → detection → response → recovery matrix):
 - ``chaos``     — fault injection (NaN step, loader error, SIGTERM, failed
                   or slow checkpoint write, hung step) proving the above in
                   ``tests/test_resilience.py``.
+
+The SERVING counterpart — engine lifecycle, decode-tick supervision,
+graceful drain, hot weight reload, deadline-aware shedding — lives in
+``zero_transformer_tpu.serving.resilience`` and reuses these primitives
+(the anomaly predicate ``anomaly.nonfinite_rows``, the ``ChaosMonkey``
+bookkeeping, the bounded-recovery shape of the supervisor).
 """
 from __future__ import annotations
 
@@ -42,13 +48,29 @@ class AnomalyHalt(RuntimeError):
     restarts forever — this needs a human (lower LR, inspect data window)."""
 
 
-from zero_transformer_tpu.resilience.anomaly import (  # noqa: E402,F401
-    AnomalyGuard,
-    HostSnapshot,
-)
-from zero_transformer_tpu.resilience.chaos import ChaosMonkey, Fault  # noqa: E402,F401
-from zero_transformer_tpu.resilience.supervisor import (  # noqa: E402,F401
-    Supervisor,
-    classify,
-)
-from zero_transformer_tpu.resilience.watchdog import Watchdog  # noqa: E402,F401
+# Lazy re-exports (PEP 562): importing the package must stay light — the
+# serving process reaches through here for the jax-only ``detect``
+# predicates and the chaos bookkeeping, and must not pay for (or couple
+# itself to) the training stack that ``anomaly``/``supervisor`` pull in
+# (optax opt-state, parallel.zero.TrainState) just to resolve the package.
+_LAZY = {
+    "AnomalyGuard": "anomaly",
+    "HostSnapshot": "anomaly",
+    "nonfinite_rows": "detect",
+    "ChaosMonkey": "chaos",
+    "Fault": "chaos",
+    "Supervisor": "supervisor",
+    "classify": "supervisor",
+    "Watchdog": "watchdog",
+}
+
+__all__ = ["RetryableError", "HangError", "AnomalyHalt", *_LAZY]
+
+
+def __getattr__(name: str):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"{__name__}.{module}"), name)

@@ -38,6 +38,11 @@ from zero_transformer_tpu.config import ResilienceConfig
 from zero_transformer_tpu.parallel.zero import TrainState, _with_ambient_mesh
 
 
+# one definition of "anomalous" across training and serving — the serving
+# tick guard imports the jax-only leaf directly (no training-stack deps)
+from zero_transformer_tpu.resilience.detect import nonfinite_rows  # noqa: F401
+
+
 @dataclasses.dataclass(frozen=True)
 class AnomalyStats:
     """Host-side view of the guard carry (one fetch per log point)."""

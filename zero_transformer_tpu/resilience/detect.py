@@ -1,0 +1,24 @@
+"""Dependency-light anomaly predicates shared by training and serving.
+
+``anomaly.py`` (the training guard) pulls in the full training stack
+(optax optimizer state, ``parallel.zero.TrainState``) — far too heavy a
+dependency for a pure-inference serving process that only needs the
+detection CRITERION. The predicates live here, in a jax-only leaf module;
+``anomaly.py`` re-exports them so training-side callers see one surface,
+and ``serving/`` imports this module directly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def nonfinite_rows(x: jax.Array) -> jax.Array:
+    """Per-row non-finite flag: ``[B, ...] -> [B]`` bool, True where any
+    element of the row is NaN/Inf. The same criterion the training guard's
+    ``AnomalyGuard._flag`` applies to loss/grad-norm, so training and
+    serving judge "anomalous" by one definition. Cheap enough to run every
+    serving tick: a [S, V] -> [S] reduction computed inside the fused step
+    and fetched alongside the sampled tokens in the same device_get."""
+    return ~jnp.isfinite(x).reshape(x.shape[0], -1).all(axis=1)

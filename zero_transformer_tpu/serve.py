@@ -264,11 +264,41 @@ def _build_generator(args) -> TextGenerator:
     )
 
 
+def _reload_loader(gen: "TextGenerator", args):
+    """Zero-arg loader for hot weight reload (SIGHUP / POST /admin/reload):
+    re-runs the STARTUP param path — msgpack import, optional int8
+    quantization, TP sharding under the serving mesh — so a swapped tree is
+    prepared exactly like the one it replaces. Runs in the reload thread,
+    never the tick thread; ``reload_params`` validates before the swap."""
+
+    def load(path: str = args.params):
+        from zero_transformer_tpu.checkpoint import import_params_msgpack
+
+        params = import_params_msgpack(path)
+        if args.quantize == "int8" and not _has_quantized_leaves(params):
+            from zero_transformer_tpu.models.quant import quantize_params
+
+            params = quantize_params(params, gen.cfg)
+        if gen.mesh is not None:
+            from zero_transformer_tpu.inference import shard_for_inference
+
+            return shard_for_inference(gen.model, params, gen.mesh)
+        return jax.tree.map(jnp.asarray, params)
+
+    return load
+
+
 def _server(gen: TextGenerator, args) -> None:
     """Continuous-batching server mode: N KV-cache slots, bounded admission
     queue, SSE token streaming (POST /generate, GET /healthz, GET /metrics).
     Sampling controls come from the CLI and are ENGINE-level (baked into the
-    fused decode step); requests vary prompt/budget/seed/deadline."""
+    fused decode step); requests vary prompt/budget/seed/deadline.
+
+    Resilience wiring: /healthz answers 503 until the engine is READY and
+    while it drains; SIGTERM closes admission and finishes in-flight
+    generations up to --drain-deadline before exiting 0; SIGHUP (or
+    POST /admin/reload) hot-swaps a new checkpoint between decode ticks
+    without dropping a slot."""
     from zero_transformer_tpu.inference import SamplingConfig
     from zero_transformer_tpu.serving import ServingEngine, run_server
     from zero_transformer_tpu.utils.monitoring import MetricsLogger
@@ -290,7 +320,12 @@ def _server(gen: TextGenerator, args) -> None:
         metrics=MetricsLogger(directory=args.metrics_dir),
         metrics_interval=args.metrics_interval,
     )
-    run_server(engine, gen.tokenizer, host=args.host, port=args.port)
+    run_server(
+        engine, gen.tokenizer, host=args.host, port=args.port,
+        reload_source=_reload_loader(gen, args),
+        drain_deadline_s=args.drain_deadline,
+        admin_token=args.admin_token,
+    )
 
 
 def _repl(gen: TextGenerator, args) -> None:
@@ -403,6 +438,18 @@ def main(argv=None) -> None:
                         "percentiles, tokens/s, occupancy)")
     p.add_argument("--metrics-interval", type=int, default=200,
                    help="log serving metrics every N scheduler ticks")
+    p.add_argument("--admin-token", default=None,
+                   help="bearer token for /admin/* from non-loopback peers "
+                        "(loopback is always allowed; without a token, "
+                        "remote admin requests get 403 — weight swapping "
+                        "must not be open to any peer that can reach a "
+                        "--host 0.0.0.0 port)")
+    p.add_argument("--drain-deadline", type=float, default=30.0,
+                   help="graceful-drain budget on SIGTERM/shutdown: "
+                        "admission closes immediately (503 + Retry-After), "
+                        "in-flight generations get this many seconds to "
+                        "finish, then are force-finished and the process "
+                        "exits 0")
     args = p.parse_args(argv)
 
     gen = _build_generator(args)

@@ -2,8 +2,12 @@
 
 The orchestration layer above the jitted decode path: a slot-based KV cache
 (``slots``), a request scheduler with deadlines/cancellation/backpressure
-(``engine``), a streaming SSE front end (``server``), and the shared
-incremental detokenizer (``detok``). See docs/DESIGN.md § Serving engine.
+(``engine``), a streaming SSE front end (``server``), the shared
+incremental detokenizer (``detok``), and the serving resilience layer
+(``resilience``: lifecycle state machine, decode-tick supervision with a
+circuit breaker, graceful drain, hot weight reload, deadline-aware load
+shedding, serving chaos harness). See docs/DESIGN.md § Serving engine and
+docs/RESILIENCE.md § Serving resilience.
 """
 from zero_transformer_tpu.serving.detok import StreamDecoder
 from zero_transformer_tpu.serving.engine import (
@@ -18,10 +22,32 @@ from zero_transformer_tpu.serving.engine import (
     RequestHandle,
     ServingEngine,
 )
+from zero_transformer_tpu.serving.resilience import (
+    DEGRADED,
+    DRAINING,
+    READY,
+    STARTING,
+    STOPPED,
+    CircuitBreaker,
+    Lifecycle,
+    ReloadError,
+    ServeFault,
+    ServingChaosMonkey,
+)
 from zero_transformer_tpu.serving.server import ServingServer, run_server
 from zero_transformer_tpu.serving.slots import SlotKVCache, vectorize_index
 
 __all__ = [
+    "DEGRADED",
+    "DRAINING",
+    "READY",
+    "STARTING",
+    "STOPPED",
+    "CircuitBreaker",
+    "Lifecycle",
+    "ReloadError",
+    "ServeFault",
+    "ServingChaosMonkey",
     "CANCELLED",
     "DONE",
     "EXPIRED",

@@ -22,6 +22,27 @@ Everything device-side is shape-static: admissions and retirements never
 recompile anything. The engine itself is synchronous (``step()``); a serving
 front end drives it from a background thread (``run()``) and talks to it
 through thread-safe ``submit()`` / ``RequestHandle``.
+
+Resilience (``serving/resilience.py`` owns the primitives):
+
+- the engine carries an explicit ``Lifecycle`` (STARTING -> READY ->
+  DEGRADED -> DRAINING -> STOPPED) that ``/healthz`` reflects;
+- the decode tick is SUPERVISED: an exception inside one tick fails only
+  the slots it poisons (retryable error to those clients), and a circuit
+  breaker trips the engine into DEGRADED and rebuilds the jitted step
+  after ``breaker_threshold`` consecutive faults (bounded by
+  ``max_rebuilds``, then the fault escalates out of ``run()``);
+- a per-tick non-finite-logits guard (the training anomaly guard's
+  predicate, ``resilience.anomaly.nonfinite_rows``) retires only affected
+  slots;
+- ``begin_drain`` stops admission (queued requests finish as retryable
+  rejections), lets in-flight generations complete up to a deadline, then
+  force-finishes — SIGTERM maps here;
+- ``reload_params`` validates a standby tree off the tick thread and swaps
+  it between ticks without dropping a slot;
+- admission sheds requests whose deadline is provably infeasible given
+  queue depth and the measured ITL EWMA (fast honest 503s, not timeout
+  storms).
 """
 from __future__ import annotations
 
@@ -44,6 +65,19 @@ from zero_transformer_tpu.inference.generate import (
     init_cache,
 )
 from zero_transformer_tpu.inference.sampling import SamplingConfig, sample_token
+from zero_transformer_tpu.resilience.detect import nonfinite_rows
+from zero_transformer_tpu.serving.resilience import (
+    DEGRADED,
+    DRAINING,
+    READY,
+    STOPPED,
+    CircuitBreaker,
+    ItlEwma,
+    Lifecycle,
+    ReloadError,
+    infeasible_deadline,
+    validate_reload,
+)
 from zero_transformer_tpu.serving.slots import SlotKVCache
 
 # request terminal states
@@ -83,6 +117,11 @@ class RequestHandle:
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.error: Optional[str] = None
+        # retryable=True marks a failure/rejection the CLIENT should retry
+        # (tick fault, drain, shed, breaker) — the server maps it to 503 +
+        # Retry-After; invalid requests stay non-retryable 400s
+        self.retryable = False
+        self.retry_after: Optional[float] = None
         self._events: queue_mod.Queue = queue_mod.Queue()
         self._done = threading.Event()
         self._cancel = threading.Event()
@@ -135,9 +174,18 @@ class RequestHandle:
         self.tokens.append(token)
         self._events.put(("token", token))
 
-    def _finish(self, status: str, now: float, error: Optional[str] = None) -> None:
+    def _finish(
+        self,
+        status: str,
+        now: float,
+        error: Optional[str] = None,
+        retryable: bool = False,
+        retry_after: Optional[float] = None,
+    ) -> None:
         self.status = status
         self.error = error
+        self.retryable = retryable
+        self.retry_after = retry_after
         self.finished_at = now
         self._events.put(("done", status))
         self._done.set()
@@ -164,6 +212,49 @@ def _percentiles(values: Sequence[float], qs=(50, 90, 99)) -> Dict[str, float]:
     return out
 
 
+def _fused_step_impl(model, sampling, params, last_logits, cache, gen_mask, rngs):
+    """Sample every slot from its own rng chain, then one fused forward.
+
+    Each row reproduces the single-request loop bit-for-bit: the rng
+    split order and the [1, V] sample shapes match ``generate()`` with
+    B=1, so a slot's trajectory is independent of its neighbors."""
+    split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
+    rngs, subs = split[:, 0], split[:, 1]
+
+    def sample_row(key, logits_row, mask_row):
+        return sample_token(key, logits_row[None], sampling, mask_row[None])[0]
+
+    token = jax.vmap(sample_row)(subs, last_logits, gen_mask)  # [S]
+    newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
+    gen_mask = gen_mask | newly
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
+    )
+    new_logits = logits[:, -1, :].astype(jnp.float32)
+    # the per-slot non-finite guard is computed IN the fused program (the
+    # training anomaly predicate inlines here) so the healthy path pays one
+    # dispatch per tick, not two, and the [S] mask rides the same device_get
+    # as the tokens
+    return (
+        token,
+        new_logits,
+        vars_out["cache"],
+        gen_mask,
+        rngs,
+        nonfinite_rows(new_logits),
+    )
+
+
+def _jit_fused_step():
+    return jax.jit(_fused_step_impl, static_argnums=(0, 1), donate_argnums=(3, 4, 5, 6))
+
+
+# one process-wide compiled step shared by every engine (warmup engines in
+# benches pre-pay compiles for the measured engine); a breaker rebuild swaps
+# in a PRIVATE _jit_fused_step() so a suspect executable is never reused
+_FUSED_SHARED = _jit_fused_step()
+
+
 class ServingEngine:
     """Slot-scheduled continuous batching over one jitted decode step.
 
@@ -187,6 +278,12 @@ class ServingEngine:
         metrics=None,
         metrics_interval: int = 0,
         clock: Callable[[], float] = time.monotonic,
+        breaker_threshold: int = 3,
+        breaker_cooldown: int = 1,
+        max_rebuilds: int = 3,
+        shed_warmup: int = 8,
+        itl_decay: float = 0.9,
+        chaos=None,
     ):
         self.cfg = cfg
         self.cache_len = cache_len or cfg.max_seq_len
@@ -213,6 +310,24 @@ class ServingEngine:
         self._ids = itertools.count()
         self._tick = 0
         self._dead: Optional[str] = None  # set by _abort; submit() fails fast
+
+        # resilience state (serving/resilience.py primitives)
+        self.lifecycle = Lifecycle(clock)
+        self._breaker = CircuitBreaker(breaker_threshold, breaker_cooldown)
+        self.max_rebuilds = max_rebuilds
+        # consecutive-incident rebuild budget: resets when the breaker
+        # closes, so a long-lived replica isn't killed by its lifetime
+        # trip COUNT after recovering cleanly from each incident
+        self._rebuilds_since_recovery = 0
+        self._itl_ewma = ItlEwma(decay=itl_decay, warmup=shed_warmup)
+        self._chaos = chaos
+        self._fused = _FUSED_SHARED  # swapped for a private jit on rebuild
+        # staged by reload_params as (tree, swap-event); swapped at tick
+        self._pending_params = None
+        self._last_reload_event: Optional[threading.Event] = None
+        self._drain_deadline: Optional[float] = None
+        self._drain_started: Optional[float] = None
+        self.drain_latency_s: Optional[float] = None
         # one zeroed single-row cache, built once: prefill's apply is
         # functional (never mutates its input), so every admission reuses
         # this template instead of paying an eval_shape retrace + a fresh
@@ -231,6 +346,17 @@ class ServingEngine:
             "tokens_out": 0,
             "peak_occupancy": 0,
             "peak_queue_depth": 0,
+            # resilience counters (exported via /metrics and logged as
+            # MetricsLogger events so serving incidents land in the same
+            # JSONL timeline the training stack writes)
+            "tick_faults": 0,
+            "poisoned_slots": 0,
+            "breaker_trips": 0,
+            "shed_infeasible": 0,
+            "rejected_draining": 0,
+            "drain_forced": 0,
+            "reloads": 0,
+            "reloads_rejected": 0,
         }
         # bounded: an unbounded all-time sample list on a long-lived server
         # is a slow memory leak AND makes every /metrics snapshot pay an
@@ -290,6 +416,21 @@ class ServingEngine:
                 # the lock: _abort drains the queue under the same lock)
                 handle._finish(FAILED, now, error=self._dead)
                 return handle
+            if self.lifecycle.state == DRAINING:
+                # admission is closed; in-flight generations finish, new
+                # traffic belongs on another replica (server: 503 +
+                # Retry-After, sized to the remaining drain window)
+                self.stats["rejected_draining"] += 1
+                left = (
+                    max(1.0, self._drain_deadline - now)
+                    if self._drain_deadline is not None
+                    else 1.0
+                )
+                handle._finish(
+                    REJECTED, now, error="server draining; retry elsewhere",
+                    retryable=True, retry_after=left,
+                )
+                return handle
             self.stats["submitted"] += 1
             if invalid is not None:
                 self.stats["rejected_invalid"] += 1
@@ -300,6 +441,21 @@ class ServingEngine:
                 handle._finish(
                     REJECTED, now,
                     error=f"queue full ({self.max_queue} waiting); retry later",
+                    retryable=True, retry_after=1.0,
+                )
+                return handle
+            if request.deadline is not None and infeasible_deadline(
+                request.deadline, now, request.max_new_tokens,
+                len(self._queue), self.n_slots, self._itl_ewma,
+            ):
+                # provably cannot finish in time: a fast honest 503 now
+                # beats decoding tokens nobody will wait for (overload
+                # degrades into sheds, not timeout storms)
+                self.stats["shed_infeasible"] += 1
+                handle._finish(
+                    REJECTED, now,
+                    error="deadline infeasible at current load (shed)",
+                    retryable=True, retry_after=1.0,
                 )
                 return handle
             self._queue.append(handle)
@@ -361,35 +517,6 @@ class ServingEngine:
         )
 
     # ----------------------------------------------------------- fused tick
-
-    @functools.partial(
-        jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4, 5, 6)
-    )
-    def _fused_step(model, sampling, params, last_logits, cache, gen_mask, rngs):  # noqa: N805
-        """Sample every slot from its own rng chain, then one fused forward.
-
-        Each row reproduces the single-request loop bit-for-bit: the rng
-        split order and the [1, V] sample shapes match ``generate()`` with
-        B=1, so a slot's trajectory is independent of its neighbors."""
-        split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
-        rngs, subs = split[:, 0], split[:, 1]
-
-        def sample_row(key, logits_row, mask_row):
-            return sample_token(key, logits_row[None], sampling, mask_row[None])[0]
-
-        token = jax.vmap(sample_row)(subs, last_logits, gen_mask)  # [S]
-        newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
-        gen_mask = gen_mask | newly
-        logits, vars_out = model.apply(
-            {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
-        )
-        return (
-            token,
-            logits[:, -1, :].astype(jnp.float32),
-            vars_out["cache"],
-            gen_mask,
-            rngs,
-        )
 
     @jax.jit
     def _install_row(last_logits, gen_mask, rngs, slot, logits_row, key):  # noqa: N805
@@ -500,28 +627,61 @@ class ServingEngine:
             self._queue = kept
 
     def step(self) -> bool:
-        """One scheduler tick: sweep, admit, fused decode, emit, retire.
-        Returns False when there was nothing to do (idle)."""
+        """One scheduler tick: swap-in reload, sweep, admit, supervised fused
+        decode, emit, retire. Returns False when there was nothing to do."""
+        self._swap_pending_params()
         self._sweep_queue()
         self._sweep_active()
         self._admit()
-        if self.active_count == 0:
+        # an idle DEGRADED engine still runs the fused step as a self-probe
+        # (all rows parked, outputs discarded): without it, a load balancer
+        # honoring the 503 starves the engine of the clean tick it needs to
+        # close the breaker, and the replica would stay DEGRADED forever
+        probe = self._breaker.open and self.active_count == 0
+        if self.active_count == 0 and not probe:
             return False
 
-        token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs = _in_mesh(
-            self.mesh,
-            ServingEngine._fused_step,
-            self.model,
-            self.sampling,
-            self.params,
-            self._last_logits,
-            self.slots.cache,
-            self._gen_mask,
-            self._rngs,
-        )
-        tokens = jax.device_get(token).tolist()  # the per-tick host sync
+        # -- supervised region: a fault here poisons AT MOST this tick's
+        # active slots, never the scheduler thread (run() stays alive and
+        # queued requests admit on the next tick)
+        try:
+            if self._chaos is not None:
+                self._chaos.on_tick(self._tick)
+            token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, bad = _in_mesh(
+                self.mesh,
+                self._fused,
+                self.model,
+                self.sampling,
+                self.params,
+                self._last_logits,
+                self.slots.cache,
+                self._gen_mask,
+                self._rngs,
+            )
+            if self._chaos is not None:
+                # injected NaNs land AFTER the step, so re-run the same
+                # predicate over the poisoned logits — injected and organic
+                # NaNs are judged by the identical criterion (the extra
+                # dispatch is chaos-only; the healthy path stays at one)
+                self._last_logits = self._chaos.poison_logits(
+                    self._tick, self._last_logits
+                )
+                bad = _in_mesh(self.mesh, nonfinite_rows, self._last_logits)
+            tokens, bad_rows = jax.device_get((token, bad))
+            tokens = tokens.tolist()
+        except Exception as exc:
+            self._on_tick_fault(exc)
+            self._tick += 1
+            return True
+        if self._breaker.record_clean():
+            self._rebuilds_since_recovery = 0
+            if not self.draining:
+                self.lifecycle.to(READY, reason="breaker closed after clean tick")
+            self._event("breaker_closed")
+
         now = self.now()
         finished: List[int] = []
+        poisoned: List[int] = []
         ttft_new: List[float] = []
         itl_new: List[float] = []
         for slot, act in enumerate(self._active):
@@ -532,15 +692,39 @@ class ServingEngine:
                 ttft_new.append(now - act.handle.submitted_at)
             elif act.last_emit_at is not None:
                 itl_new.append(now - act.last_emit_at)
+            # this tick's token was sampled from the PREVIOUS (finite)
+            # logits, so it is valid even when the new logits went bad —
+            # emit it, then retire the poisoned slot with a retryable error
             act.handle._emit(t, now)
             act.emitted += 1
             act.last_emit_at = now
             self.stats["tokens_out"] += 1
             hit_eos = self.eos_token_id is not None and t == self.eos_token_id
             if hit_eos or act.emitted >= act.handle.request.max_new_tokens:
+                # completion outranks the poison flag: this tick's token came
+                # from the PREVIOUS finite logits, so a request finishing now
+                # delivered a fully valid output — the bad NEW logits would
+                # never have been sampled from
                 act.handle._finish(DONE, now)
                 self.stats["completed"] += 1
                 finished.append(slot)
+            elif bool(bad_rows[slot]):
+                act.handle._finish(
+                    FAILED, now,
+                    error="non-finite logits in decode (retryable)",
+                    retryable=True,
+                )
+                self.stats["poisoned_slots"] += 1
+                poisoned.append(slot)
+                finished.append(slot)
+        if any(bad_rows):
+            # zero EVERY bad row (poisoned-and-retired or finished-anyway)
+            # so a parked slot never feeds NaN back into the next tick's
+            # sample — retirement alone leaves the row in place
+            keep = jnp.asarray([not b for b in bad_rows], jnp.bool_)
+            self._last_logits = jnp.where(keep[:, None], self._last_logits, 0.0)
+        if poisoned:
+            self._event("poisoned_slots", slots=len(poisoned))
         if ttft_new or itl_new:
             # under the lock: metrics_snapshot copies these deques from HTTP
             # handler threads, and CPython raises on a deque mutated
@@ -548,6 +732,8 @@ class ServingEngine:
             with self._lock:
                 self._ttft.extend(ttft_new)
                 self._itl.extend(itl_new)
+            for sample in itl_new:
+                self._itl_ewma.update(sample)
         self._retire(finished)
 
         self._tick += 1
@@ -557,22 +743,243 @@ class ServingEngine:
             and self._tick % self.metrics_interval == 0
         ):
             self.metrics.log(self.metrics_snapshot(), step=self._tick, prefix="serve")
+        return not probe
+
+    # ------------------------------------------------------ tick supervision
+
+    def _event(self, name: str, **fields) -> None:
+        """Resilience incident -> the same JSONL/wandb timeline the training
+        stack writes (MetricsLogger.event), keyed by scheduler tick."""
+        if self.metrics is not None:
+            self.metrics.event(name, step=self._tick, **fields)
+
+    def _on_tick_fault(self, exc: Exception) -> None:
+        """One decode tick failed: fail ONLY the slots it poisoned (their
+        clients get a retryable error event), reallocate the device state
+        the tick may have invalidated, and let the breaker escalate —
+        DEGRADED + a freshly jitted step after ``threshold`` consecutive
+        faults, a loud abort after ``max_rebuilds`` consecutive rebuilds."""
+        self.stats["tick_faults"] += 1
+        now = self.now()
+        failed = [s for s, a in enumerate(self._active) if a is not None]
+        for slot in failed:
+            self._active[slot].handle._finish(
+                FAILED, now,
+                error=f"decode tick failed (retryable): {exc!r}",
+                retryable=True,
+            )
+            # HOST-only cleanup — _retire would run the jitted index reset
+            # over self.slots.cache, whose buffers the faulted (donating)
+            # call may have deleted, re-raising INSIDE the fault handler and
+            # killing the scheduler; _rebuild_device_state below replaces
+            # the whole SlotKVCache (free list included) instead
+            self._active[slot] = None
+        self._event("tick_fault", error=repr(exc), slots_failed=len(failed))
+        if self._breaker.record_fault():
+            self.stats["breaker_trips"] += 1
+            self._rebuilds_since_recovery += 1
+            if self._rebuilds_since_recovery > self.max_rebuilds:
+                # a fault that survives this many CONSECUTIVE rebuilds is
+                # structural, not transient — fail everything outstanding
+                # (any driver, not just run(), must leave no handle hanging)
+                # and escalate so the replica dies loudly; the orchestrator
+                # owns restarts, not this loop
+                reason = (
+                    f"engine faulted through {self.max_rebuilds} rebuilds; "
+                    f"last error: {exc!r}"
+                )
+                self._abort(reason)
+                raise RuntimeError(reason) from exc
+            self.lifecycle.to(
+                DEGRADED,
+                reason=f"breaker open after {self._breaker.threshold} faults",
+            )
+            self._event("breaker_trip", trips=self.stats["breaker_trips"])
+            # the executable itself is suspect only once faults PERSIST:
+            # swap in a privately jitted step on each trip
+            self._fused = _jit_fused_step()
+        # device buffers are suspect after EVERY fused-call fault, threshold
+        # or not: the step donates logits/cache/masks/rngs, so an exception
+        # after dispatch leaves them deleted or half-written — reusing them
+        # would fail the NEXT tick's fresh admissions too (blast radius must
+        # stay at THIS tick's slots)
+        self._rebuild_device_state()
+
+    def _rebuild_device_state(self) -> None:
+        """Reallocate every device buffer the tick thread owns; nothing from
+        a suspect tick is reused. Host state (queue, stats, lifecycle) and
+        params are untouched."""
+        self.slots = SlotKVCache(self.model, self.n_slots, mesh=self.mesh)
+        V = self.cfg.vocab_size
+        self._last_logits = jnp.zeros((self.n_slots, V), jnp.float32)
+        self._gen_mask = jnp.zeros((self.n_slots, V), jnp.bool_)
+        self._rngs = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
+        self._active = [None] * self.n_slots
+        self._prefill_cache = init_cache(self.model, 1, mesh=self.mesh)
+        self._event("engine_rebuilt")
+
+    # ----------------------------------------------------------------- drain
+
+    @property
+    def draining(self) -> bool:
+        return self.lifecycle.state == DRAINING
+
+    def begin_drain(self, deadline_s: Optional[float] = 30.0) -> bool:
+        """Stop admission and start finishing in-flight generations
+        (SIGTERM maps here). Queued requests finish immediately as
+        retryable rejections (their slot time belongs to requests already
+        decoding); actives run to completion until ``deadline_s``, after
+        which ``poll_drain`` force-finishes them. Thread-safe; idempotent."""
+        now = self.now()
+        if not self.lifecycle.to(DRAINING, reason="drain requested"):
+            return False
+        with self._lock:
+            self._drain_started = now
+            self._drain_deadline = (
+                now + deadline_s if deadline_s is not None else None
+            )
+            queued, self._queue = list(self._queue), deque()
+        for handle in queued:
+            self.stats["rejected_draining"] += 1
+            handle._finish(
+                REJECTED, now, error="server draining; retry elsewhere",
+                retryable=True,
+                retry_after=max(1.0, deadline_s) if deadline_s else 1.0,
+            )
+        self._event(
+            "drain_begin", queued_rejected=len(queued), active=self.active_count
+        )
         return True
 
-    def run(self, stop: threading.Event, idle_sleep: float = 0.001) -> None:
-        """Scheduler loop for a background thread: step until ``stop``.
+    def poll_drain(self) -> bool:
+        """Called between ticks while draining: True once the engine has
+        fully drained (or the deadline forced it) and is STOPPED."""
+        if not self.draining:
+            return self.lifecycle.state == STOPPED
+        now = self.now()
+        if self.active_count == 0 and self.queue_depth == 0:
+            self._finish_drain(forced=0)
+            return True
+        if self._drain_deadline is not None and now > self._drain_deadline:
+            forced = [s for s, a in enumerate(self._active) if a is not None]
+            for slot in forced:
+                self._active[slot].handle._finish(
+                    FAILED, now,
+                    error="drain deadline exceeded; generation force-finished",
+                    retryable=True,
+                )
+            self._retire(forced)
+            self.stats["drain_forced"] += len(forced)
+            self._finish_drain(forced=len(forced))
+            return True
+        return False
 
-        A step() exception would otherwise kill the thread SILENTLY: every
-        in-flight handle waits forever on a 'done' event that never comes
-        while /healthz keeps answering — a hung total outage. Fail loudly
-        instead: finish every active and queued handle as ``failed`` (so
-        blocked clients unblock with the error), then re-raise."""
+    def _finish_drain(self, forced: int) -> None:
+        now = self.now()
+        self.drain_latency_s = (
+            now - self._drain_started if self._drain_started is not None else 0.0
+        )
+        with self._lock:
+            self._dead = "engine drained (stopped)"
+        self.lifecycle.to(STOPPED, reason="drained")
+        self._event(
+            "drain_done", forced=forced, drain_latency_s=self.drain_latency_s
+        )
+
+    # ------------------------------------------------------------ hot reload
+
+    def reload_params(self, source) -> Dict[str, Any]:
+        """Stage a standby param tree and swap it in between ticks — no slot
+        is retired; in-flight generations continue on the new weights from
+        their next token.
+
+        ``source`` is a param tree or a zero-arg callable returning one
+        (e.g. a lambda over ``checkpoint.import_params_msgpack``). Called
+        OFF the tick thread (HTTP handler, SIGHUP thread): the load and the
+        eval_shape validation happen here; the tick thread only flips a
+        reference. A corrupt or mismatched artifact raises ``ReloadError``
+        and the engine keeps serving the old weights, READY throughout."""
+        try:
+            tree = source() if callable(source) else source
+            if self._chaos is not None:
+                tree = self._chaos.corrupt_reload(tree)
+            validate_reload(self.params, tree)
+            tree = jax.tree.map(jnp.asarray, tree)
+            # runtime-owned buffers before the swap: msgpack/orbax restores
+            # and device_put can hand back zero-copy host views, and a
+            # donating consumer of such a buffer corrupts the heap on this
+            # image's jax (see jax_compat.ensure_donatable). Under a TP
+            # mesh the caller's loader must pre-shard (shard_for_inference)
+            # exactly as serve.py does at startup.
+            from zero_transformer_tpu.utils.jax_compat import ensure_donatable
+
+            tree = ensure_donatable(tree)
+        except ReloadError as exc:
+            self.stats["reloads_rejected"] += 1
+            self._event("reload_rejected", error=str(exc))
+            raise
+        except Exception as exc:
+            self.stats["reloads_rejected"] += 1
+            self._event("reload_rejected", error=repr(exc))
+            raise ReloadError(f"reload failed to load: {exc!r}") from exc
+        swap_event = threading.Event()
+        with self._lock:
+            if self._dead is not None:
+                # no tick thread will ever swap this in — fail fast (409)
+                # instead of letting the admin caller block a full swap
+                # timeout for a misleading "staged"
+                self.stats["reloads_rejected"] += 1
+                raise ReloadError(f"engine is not serving: {self._dead}")
+            # a superseded (staged-but-unswapped) predecessor never serves:
+            # its event stays unset and its caller truthfully gets "staged,
+            # not swapped" rather than credit for a swap that was B's
+            self._pending_params = (tree, swap_event)
+            self._last_reload_event = swap_event
+        return {
+            "staged": True,
+            "swapped": swap_event,  # PER-RELOAD: set only when THIS tree serves
+            "reloads": self.stats["reloads"],
+        }
+
+    def _swap_pending_params(self) -> None:
+        """Tick-thread side of reload: flip the param reference at a tick
+        boundary, so prefill and the fused step inside one tick always see
+        ONE tree. Active slots keep their cache rows — nothing retires."""
+        with self._lock:
+            pending, self._pending_params = self._pending_params, None
+        if pending is None:
+            return
+        self.params, swap_event = pending
+        self.stats["reloads"] += 1
+        swap_event.set()
+        self._event("reload_swapped", reloads=self.stats["reloads"])
+
+    def wait_reload(self, timeout: Optional[float] = 10.0) -> bool:
+        """Block until the most recently STAGED reload has swapped in."""
+        event = self._last_reload_event
+        return event.wait(timeout=timeout) if event is not None else False
+
+    # ------------------------------------------------------------- scheduler
+
+    def run(self, stop: threading.Event, idle_sleep: float = 0.001) -> None:
+        """Scheduler loop for a background thread: step until ``stop`` or a
+        completed drain.
+
+        A non-tick exception (tick faults are supervised inside ``step``)
+        would otherwise kill the thread SILENTLY: every in-flight handle
+        waits forever on a 'done' event that never comes while /healthz
+        keeps answering — a hung total outage. Fail loudly instead: finish
+        every active and queued handle as ``failed`` (so blocked clients
+        unblock with the error), then re-raise."""
+        self.lifecycle.to(READY, reason="scheduler started")
         while not stop.is_set():
             try:
                 busy = self.step()
             except Exception as exc:
                 self._abort(f"scheduler died: {exc!r}")
                 raise
+            if self.draining and self.poll_drain():
+                return  # drained clean: nothing queued or active remains
             if not busy:
                 time.sleep(idle_sleep)
         # graceful stop: anything still queued or mid-decode will never get
@@ -583,6 +990,7 @@ class ServingEngine:
         """Terminate every outstanding request with ``failed`` and mark the
         engine dead so later ``submit()`` calls fail fast too."""
         now = self.now()
+        self.lifecycle.to(STOPPED, reason=reason)
         with self._lock:
             self._dead = reason
             queued, self._queue = list(self._queue), deque()
@@ -610,6 +1018,10 @@ class ServingEngine:
             "tokens_per_sec": self.stats["tokens_out"] / elapsed,
             "slot_occupancy": self.active_count,
             "queue_depth": len(self._queue),
+            "state": self.lifecycle.state,
+            "uptime_s": self.lifecycle.uptime_s,
+            "breaker_open": self._breaker.open,
+            "itl_ewma_ms": (self._itl_ewma.value or 0.0) * 1e3,
         }
         with self._lock:  # step() extends these under the same lock
             ttft, itl = list(self._ttft), list(self._itl)
@@ -620,6 +1032,8 @@ class ServingEngine:
             "submitted", "completed", "rejected_queue_full", "rejected_invalid",
             "expired_queued", "expired_decoding", "cancelled", "tokens_out",
             "peak_occupancy", "peak_queue_depth",
+            "tick_faults", "poisoned_slots", "breaker_trips", "shed_infeasible",
+            "rejected_draining", "drain_forced", "reloads", "reloads_rejected",
         ):
             snap[k] = self.stats[k]
         return snap

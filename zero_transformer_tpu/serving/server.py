@@ -11,25 +11,40 @@ CUDA+gradio app (reference ``app.py``). Endpoints:
   a final ``data: {"done": true, "status": ..., "text": full}``. Without, a
   single JSON document. Backpressure maps to HTTP 429 (queue full) / 400
   (invalid request).
-- ``GET /healthz``: liveness + occupancy/queue snapshot.
+- ``GET /healthz``: the engine's LIFECYCLE, with real status codes — 200
+  only when READY; 503 while starting, degraded (breaker open), draining,
+  or stopped, so a load balancer routes around a sick replica. Body:
+  ``{"state", "uptime_s", "reloads", "breaker_open", ...}``.
 - ``GET /metrics``: the full serving-metrics snapshot (TTFT/ITL percentiles,
-  tokens/s, rejects) as JSON.
+  tokens/s, rejects, resilience counters) as JSON.
+- ``POST /admin/reload``: hot weight reload — load a standby msgpack tree
+  off the tick thread, validate, swap between ticks without dropping a
+  slot (also wired to SIGHUP by ``install_signal_handlers``).
 
 One scheduler thread drives ``engine.step()``; HTTP handler threads only
 ``submit()`` and drain per-request queues, so a slow client never stalls
 decode for everyone else (the whole point of continuous batching).
+Retryable rejections (drain, shed, breaker) map to 503 + ``Retry-After``;
+request bodies are bounded (413) so an oversized POST can't balloon the
+stdlib handler. SIGTERM (``install_signal_handlers``) begins a graceful
+drain: admission closes, in-flight streams finish up to the drain
+deadline, then the process exits 0.
 """
 from __future__ import annotations
 
 import json
+import math
 import select
+import signal
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from zero_transformer_tpu.serving.detok import StreamDecoder, decode_tokens
 from zero_transformer_tpu.serving.engine import FAILED, REJECTED, ServingEngine
+from zero_transformer_tpu.serving.resilience import READY, STOPPED, ReloadError
 
 # how long an SSE handler blocks on the next token before re-checking that
 # the client is still connected (a request parked in the admission queue, or
@@ -55,9 +70,20 @@ class ServingServer:
     """Own the HTTP server + the engine's scheduler thread."""
 
     def __init__(self, engine: ServingEngine, tokenizer, host: str = "127.0.0.1",
-                 port: int = 8000):
+                 port: int = 8000, max_body_bytes: int = 1 << 20,
+                 reload_source=None, admin_token: Optional[str] = None):
         self.engine = engine
         self.tokenizer = tokenizer
+        self.max_body_bytes = max_body_bytes
+        # reload source for SIGHUP / POST /admin/reload: a msgpack path, or
+        # a loader callable — called with the request's path when one is
+        # given, with no args otherwise (serve.py's loader replays the full
+        # startup path: import -> quantize -> TP shard)
+        self.reload_source = reload_source
+        # /admin/* access: loopback peers always; non-loopback only with
+        # this bearer token (weight swapping must not be open to any peer
+        # that can reach a --host 0.0.0.0 port)
+        self.admin_token = admin_token
         self._stop = threading.Event()
         self._scheduler = threading.Thread(
             target=engine.run, args=(self._stop,), name="serve-scheduler",
@@ -70,36 +96,48 @@ class ServingServer:
             def log_message(self, fmt, *args):  # noqa: A003
                 pass
 
-            def _json(self, code: int, obj) -> None:
+            def _json(self, code: int, obj, headers=None) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
-                    # a dead scheduler thread means nothing will ever decode
-                    # again — that must not read as "ok" to a load balancer
-                    alive = outer._scheduler.is_alive() or not outer._scheduler.ident
-                    self._json(200 if alive else 503, {
-                        "status": "ok" if alive else "scheduler dead",
-                        "slots": outer.engine.n_slots,
-                        "active": outer.engine.active_count,
-                        "queued": outer.engine.queue_depth,
-                    })
+                    self._json(*outer._healthz())
                 elif self.path == "/metrics":
                     self._json(200, outer.engine.metrics_snapshot())
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802
-                if self.path != "/generate":
+                if self.path not in ("/generate", "/admin/reload"):
                     self._json(404, {"error": f"no route {self.path}"})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self._json(400, {"error": "bad Content-Length"})
+                    return
+                if length < 0:
+                    # rfile.read(-1) would read until EOF — unbounded, the
+                    # exact balloon the body bound exists to prevent
+                    self._json(400, {"error": "bad Content-Length"})
+                    return
+                if length > outer.max_body_bytes:
+                    # bound BEFORE reading: an oversized POST must not
+                    # balloon the stdlib handler's memory. The unread body
+                    # would desynchronize the connection — close it.
+                    self.close_connection = True
+                    self._json(413, {
+                        "error": f"body exceeds {outer.max_body_bytes} bytes",
+                    })
+                    return
+                try:
                     req = json.loads(self.rfile.read(length) or b"{}")
                 except (ValueError, json.JSONDecodeError):
                     self._json(400, {"error": "malformed JSON body"})
@@ -109,7 +147,14 @@ class ServingServer:
                     # client's error, not a handler-thread traceback
                     self._json(400, {"error": "body must be a JSON object"})
                     return
-                outer._generate(self, req)
+                if self.path == "/admin/reload":
+                    if not outer._admin_allowed(self):
+                        self._json(403, {"error": "admin endpoint: loopback "
+                                                  "or bearer token required"})
+                        return
+                    self._json(*outer._reload(req))
+                else:
+                    outer._generate(self, req)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -120,15 +165,24 @@ class ServingServer:
 
     # ------------------------------------------------------------ lifecycle
 
-    def start(self) -> None:
-        self._scheduler.start()
+    def start(self, start_scheduler: bool = True) -> None:
+        """``start_scheduler=False`` serves HTTP with the engine still
+        STARTING (tests assert /healthz is 503 before readiness; a real
+        deployment would use it to finish warmup before taking traffic) —
+        call ``start_scheduler()`` to go READY."""
+        if start_scheduler:
+            self.start_scheduler()
         self._server_thread = threading.Thread(
             target=self._httpd.serve_forever, name="serve-http", daemon=True
         )
         self._server_thread.start()
 
+    def start_scheduler(self) -> None:
+        if not self._scheduler.ident:
+            self._scheduler.start()
+
     def serve_forever(self) -> None:
-        self._scheduler.start()
+        self.start_scheduler()
         try:
             self._httpd.serve_forever()
         finally:
@@ -137,6 +191,124 @@ class ServingServer:
     def stop(self) -> None:
         self._stop.set()
         self._httpd.shutdown()
+
+    # ------------------------------------------------------------ resilience
+
+    def _healthz(self):
+        """(code, body) for /healthz: 200 ONLY when the engine is READY and
+        its scheduler thread is alive — warming up, degraded, draining, and
+        stopped all answer 503 so a load balancer stops routing here."""
+        state = self.engine.lifecycle.state
+        alive = self._scheduler.is_alive() or not self._scheduler.ident
+        if not alive and state != STOPPED:
+            state = "scheduler dead"
+        ok = state == READY and alive
+        return (200 if ok else 503), {
+            "status": "ok" if ok else state,
+            "state": state,
+            "uptime_s": round(self.engine.lifecycle.uptime_s, 3),
+            "reloads": self.engine.stats["reloads"],
+            "breaker_open": self.engine._breaker.open,
+            "slots": self.engine.n_slots,
+            "active": self.engine.active_count,
+            "queued": self.engine.queue_depth,
+        }
+
+    def _admin_allowed(self, handler) -> bool:
+        peer = handler.client_address[0]
+        if peer in ("127.0.0.1", "::1", "::ffff:127.0.0.1"):
+            return True
+        if self.admin_token:
+            auth = handler.headers.get("Authorization", "")
+            return auth == f"Bearer {self.admin_token}"
+        return False
+
+    def _reload(self, req: dict):
+        """(code, body) for POST /admin/reload: load a standby tree in THIS
+        handler thread (off the tick thread), validate, swap between ticks.
+        409 on a corrupt/mismatched artifact — the engine stays READY on
+        the old weights.
+
+        A request path is handed to the CONFIGURED loader when one exists
+        (so int8-quantized / TP-sharded servers prepare the reloaded tree
+        exactly like the startup tree); the bare msgpack import is only the
+        fallback for servers configured without a loader."""
+        path = req.get("params")
+        if callable(self.reload_source):
+            loader = self.reload_source
+            source = (lambda: loader(path)) if path else loader
+        elif path or isinstance(self.reload_source, str):
+            load_path = path or self.reload_source
+
+            def source():
+                from zero_transformer_tpu.checkpoint import import_params_msgpack
+
+                return import_params_msgpack(load_path)
+        else:
+            return 400, {"error": "no reload source: pass {\"params\": <path>}"}
+        try:
+            info = self.engine.reload_params(source)
+        except ReloadError as exc:
+            return 409, {
+                "error": str(exc),
+                "state": self.engine.lifecycle.state,
+                "reloads": self.engine.stats["reloads"],
+            }
+        # wait on THIS reload's swap event (not a shared latest-reload flag:
+        # concurrent staging must not let one caller claim another's swap)
+        swapped = info["swapped"].wait(timeout=30.0)
+        return (200 if swapped else 202), {
+            "reloaded": swapped,
+            "reloads": self.engine.stats["reloads"],
+            "state": self.engine.lifecycle.state,
+        }
+
+    def drain(self, deadline_s: Optional[float] = 30.0) -> None:
+        """Begin a graceful drain and, once the engine reports STOPPED (or
+        the deadline plus grace expires), shut the HTTP server down.
+        ``deadline_s=None`` honors the engine contract — wait indefinitely
+        for in-flight generations (no silent 10-second cutoff)."""
+        self.engine.begin_drain(deadline_s)
+        give_up = (
+            None if deadline_s is None
+            else time.monotonic() + deadline_s + 10.0
+        )
+        while self.engine.lifecycle.state != STOPPED and (
+            give_up is None or time.monotonic() < give_up
+        ):
+            time.sleep(0.05)
+        self.stop()
+
+    def install_signal_handlers(
+        self, drain_deadline_s: Optional[float] = 30.0
+    ) -> None:
+        """SIGTERM -> graceful drain (in a helper thread: the handler must
+        return immediately); SIGHUP -> hot reload from ``reload_source``.
+        The drain ends with ``stop()``, which returns the blocking
+        ``serve_forever()`` caller — the process then exits 0, the contract
+        an orchestrator's preemption hook expects."""
+
+        def on_term(signum, frame):
+            threading.Thread(
+                target=self.drain, args=(drain_deadline_s,),
+                name="serve-drain", daemon=True,
+            ).start()
+
+        def on_hup(signum, frame):
+            if self.reload_source is None:
+                return
+
+            def _reload():
+                try:
+                    self._reload({})
+                except Exception:
+                    pass  # already counted/evented by the engine
+
+            threading.Thread(target=_reload, name="serve-reload", daemon=True).start()
+
+        signal.signal(signal.SIGTERM, on_term)
+        if hasattr(signal, "SIGHUP"):
+            signal.signal(signal.SIGHUP, on_hup)
 
     # -------------------------------------------------------------- request
 
@@ -161,8 +333,22 @@ class ServingServer:
             handler._json(400, {"error": f"bad request field: {exc}"})
             return
         if handle.status == REJECTED:
-            code = 429 if "queue full" in (handle.error or "") else 400
-            handler._json(code, {"error": handle.error, "status": handle.status})
+            if handle.retryable:
+                # drain / shed / backpressure: honest fast failure the
+                # client should retry elsewhere — Retry-After sized by the
+                # engine (remaining drain window, or a beat for the queue)
+                code = 429 if "queue full" in (handle.error or "") else 503
+                handler._json(
+                    code,
+                    {"error": handle.error, "status": handle.status},
+                    headers={
+                        "Retry-After": str(
+                            max(1, math.ceil(handle.retry_after or 1.0))
+                        )
+                    },
+                )
+            else:
+                handler._json(400, {"error": handle.error, "status": handle.status})
             return
         if handle.status == FAILED:
             # dead engine: an outage must read as 503, never as a 200 with
@@ -244,17 +430,28 @@ def run_server(
     host: str = "127.0.0.1",
     port: int = 8000,
     background: bool = False,
+    reload_source=None,
+    drain_deadline_s: Optional[float] = 30.0,
+    max_body_bytes: int = 1 << 20,
+    admin_token: Optional[str] = None,
 ) -> Optional[ServingServer]:
     """Start the serving front end. ``background=True`` returns the running
-    server (tests); otherwise blocks until interrupted."""
-    server = ServingServer(engine, tokenizer, host=host, port=port)
+    server (tests); otherwise blocks until SIGTERM (graceful drain, exit 0)
+    or interrupt, with SIGHUP hot-reloading from ``reload_source``."""
+    server = ServingServer(
+        engine, tokenizer, host=host, port=port,
+        max_body_bytes=max_body_bytes, reload_source=reload_source,
+        admin_token=admin_token,
+    )
     if background:
         server.start()
         return server
+    server.install_signal_handlers(drain_deadline_s=drain_deadline_s)
     print(
         f"serving on http://{host}:{server.port} "
         f"({engine.n_slots} slots, cache_len {engine.cache_len}) — "
-        "POST /generate, GET /healthz, GET /metrics",
+        "POST /generate, GET /healthz, GET /metrics, POST /admin/reload; "
+        f"SIGTERM drains ({drain_deadline_s}s deadline), SIGHUP reloads",
         flush=True,
     )
     server.serve_forever()
