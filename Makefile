@@ -37,11 +37,24 @@ bench:
 	$(PY) bench.py
 
 # Continuous-batching serving bench: 8 concurrent clients against a 2-slot
-# engine on the CPU test model, every response verified byte-identical to
-# single-request generate(). Emits BENCH_serve.json (TTFT/ITL percentiles,
-# tokens/s, occupancy); schema pinned by tests/test_serve_bench.py.
+# engine on the CPU test model (chunked prefill on by default), every
+# response verified byte-identical to single-request generate(). Two
+# scenarios: the standard mixed-length run (-> BENCH_serve.json) and the
+# shared-prefix run (N personas x one system prompt -> BENCH_serve_prefix.json,
+# proving prefix-cache hits + the TTFT hit/miss split). A regression guard
+# compares the fresh standard run against the previously committed artifact
+# (>15% on decode_tok_s / itl p99 fails loudly on matching hardware, skips
+# otherwise). Schema pinned by tests/test_serve_bench.py.
 serve-bench:
+	@cp BENCH_serve.json /tmp/_serve_baseline.json 2>/dev/null || true
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
+		--shared-prefix --cache-len 64 --out BENCH_serve_prefix.json
+	@if [ -f /tmp/_serve_baseline.json ]; then \
+		$(PY) scripts/serve_bench_guard.py /tmp/_serve_baseline.json BENCH_serve.json; \
+	else \
+		echo "serve-bench-guard: no committed baseline; skipping"; \
+	fi
 
 # Retry the bench ladder until a live on-chip measurement lands, then promote
 # it to BENCH_measured.json (this image's TPU tunnel wedges for hours at a

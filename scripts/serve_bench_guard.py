@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Serving-bench regression guard: fresh BENCH_serve.json vs the committed
+baseline.
+
+``make serve-bench`` snapshots the committed artifact before the load run,
+then calls this with (baseline, fresh). The guard FAILS LOUDLY (exit 1)
+when, on matching hardware, either headline metric regresses past the
+tolerance:
+
+- ``decode_tok_s`` (aggregate decode throughput) drops > 15%
+- ``itl_ms.p99`` (tail inter-token latency) grows > 15%
+
+"Matching hardware" is judged from the artifact's ``platform`` block (jax
+backend + device kind): a TPU box must not be graded against a CPU
+baseline, and a baseline from before the platform field existed can only be
+skipped. Skips exit 0 with a reason — the guard's job is catching real
+regressions on comparable runs, not adding noise on incomparable ones.
+
+Usage: serve_bench_guard.py <baseline.json> <fresh.json> [--tolerance 0.15]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+TOLERANCE = 0.15
+
+
+def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
+    """Returns (ok, messages). ok=True covers both pass and skip."""
+    msgs = []
+    base_platform = baseline.get("platform")
+    fresh_platform = fresh.get("platform")
+    if not base_platform or not fresh_platform:
+        return True, ["SKIP: baseline or fresh artifact lacks a platform block"]
+    if base_platform != fresh_platform:
+        return True, [
+            f"SKIP: hardware mismatch (baseline {base_platform} vs "
+            f"fresh {fresh_platform}); not comparable"
+        ]
+    if baseline.get("workload", "mixed") != fresh.get("workload", "mixed"):
+        return True, ["SKIP: different workloads; not comparable"]
+
+    ok = True
+    base_tps = baseline.get("decode_tok_s", baseline.get("value", 0))
+    fresh_tps = fresh.get("decode_tok_s", fresh.get("value", 0))
+    if base_tps and fresh_tps < base_tps * (1 - tolerance):
+        ok = False
+        msgs.append(
+            f"REGRESSION: decode_tok_s {fresh_tps:.1f} < "
+            f"{(1 - tolerance) * 100:.0f}% of baseline {base_tps:.1f}"
+        )
+    else:
+        msgs.append(f"ok: decode_tok_s {fresh_tps:.1f} (baseline {base_tps:.1f})")
+
+    base_p99 = baseline.get("itl_ms", {}).get("p99", 0)
+    fresh_p99 = fresh.get("itl_ms", {}).get("p99", 0)
+    if base_p99 and fresh_p99 > base_p99 * (1 + tolerance):
+        ok = False
+        msgs.append(
+            f"REGRESSION: itl_ms.p99 {fresh_p99:.3f} ms > "
+            f"{(1 + tolerance) * 100:.0f}% of baseline {base_p99:.3f} ms"
+        )
+    else:
+        msgs.append(f"ok: itl_ms.p99 {fresh_p99:.3f} ms (baseline {base_p99:.3f} ms)")
+    return ok, msgs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("baseline", help="committed BENCH_serve.json snapshot")
+    p.add_argument("fresh", help="artifact from the run under test")
+    p.add_argument("--tolerance", type=float, default=TOLERANCE)
+    args = p.parse_args(argv)
+    baseline = json.loads(Path(args.baseline).read_text())
+    fresh = json.loads(Path(args.fresh).read_text())
+    ok, msgs = compare(baseline, fresh, args.tolerance)
+    for m in msgs:
+        print(f"serve-bench-guard: {m}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

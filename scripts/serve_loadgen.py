@@ -10,6 +10,15 @@ scheduler + fused decode step, not socket overhead) in either mode:
   completions — the latency-under-load measurement (closed-loop hides
   queueing delay by self-throttling).
 
+Workloads: the default mix varies prompt lengths across prefill buckets;
+``--shared-prefix`` instead models N personas behind one common system
+prompt (the prefix spans >= 2 prefill chunks), so the engine's chunk-aligned
+prefix cache gets real hits and the artifact can attribute TTFT to hit vs
+miss admissions. Chunked prefill is ON by default (``--prefill-chunk``;
+0 restores the legacy one-shot prefill) and the artifact splits ITL into
+all-ticks vs pure-decode ticks (``itl_ms`` vs ``itl_ms_decode_only``) so
+prefill interference is measurable, not inferred.
+
 Every request's token stream is checked byte-for-byte against single-request
 ``generate()`` with the same seed (``--no-verify`` to skip): the engine's
 request-isolation invariant, measured under real contention. The run emits a
@@ -47,6 +56,17 @@ def parse_args(argv=None):
                    help="open-loop arrival rate, requests/s")
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--cache-len", type=int, default=None)
+    p.add_argument("--prefill-chunk", type=int, default=8,
+                   help="chunked-prefill budget (tokens per tick) for the "
+                        "measured engine; 0 = legacy one-shot prefill")
+    p.add_argument("--prefix-cache", type=int, default=64, metavar="CHUNKS",
+                   help="prefix-cache capacity in chunk entries (0 = off; "
+                        "forced off when --prefill-chunk is 0)")
+    p.add_argument("--shared-prefix", action="store_true",
+                   help="shared-prefix workload: every request = one common "
+                        "system prompt (>= 2 chunks long) + a short persona "
+                        "tail, so prefix-cache hits and the TTFT hit/miss "
+                        "split are measured on realistic traffic")
     p.add_argument("--max-queue", type=int, default=1024,
                    help="admission-queue depth (large: the loadgen measures "
                         "latency under queueing, not reject behavior)")
@@ -71,10 +91,23 @@ def parse_args(argv=None):
 
 def make_requests(args, vocab_size: int, cache_len: int):
     """Deterministic request mix: varied prompt lengths so admissions cross
-    prefill buckets, seeds offset from --seed."""
+    prefill buckets, seeds offset from --seed. With --shared-prefix, every
+    prompt is one common system prefix (>= 2 prefill chunks when the cache
+    budget allows) + a short unique persona tail."""
     rng = random.Random(1234)
-    max_prompt = max(2, min(8, cache_len - args.max_new_tokens))
     out = []
+    if args.shared_prefix:
+        chunk = max(1, args.prefill_chunk)
+        # the prefix must leave room for the tail and the generation:
+        # prefix + tail + max_new - 1 <= cache_len
+        budget = cache_len - args.max_new_tokens - 4 + 1
+        prefix_len = max(chunk + 1, min(2 * chunk, budget))
+        prefix = [rng.randint(1, vocab_size - 1) for _ in range(prefix_len)]
+        for i in range(args.requests):
+            tail = [rng.randint(1, vocab_size - 1) for _ in range(rng.randint(2, 4))]
+            out.append((prefix + tail, args.seed + i))
+        return out
+    max_prompt = max(2, min(8, cache_len - args.max_new_tokens))
     for i in range(args.requests):
         length = rng.randint(2, max_prompt)
         prompt = [rng.randint(1, vocab_size - 1) for _ in range(length)]
@@ -98,10 +131,13 @@ def build(args):
     sampling = SamplingConfig(temperature=0.9, top_k=20)
     cache_len = args.cache_len or cfg.max_seq_len
 
-    def engine(chaos=None):
+    def engine(chaos=None, prefix_cache=None):
+        chunks = prefix_cache if prefix_cache is not None else args.prefix_cache
         return ServingEngine(
             cfg, params, n_slots=args.slots, cache_len=cache_len,
             sampling=sampling, max_queue=args.max_queue, chaos=chaos,
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache_chunks=chunks if args.prefill_chunk else 0,
         )
 
     return cfg, params, sampling, cache_len, engine
@@ -135,6 +171,25 @@ def reference_outputs(cfg, params, sampling, cache_len, requests, max_new):
         )
         refs.append(jax.device_get(toks)[0].tolist())
     return refs
+
+
+def prefill_p50(handles, pred=lambda h: True):
+    """p50 of admission -> first token, in ms. The prefill+first-decode
+    component the ENGINE controls: under a closed loop, FULL TTFT is
+    dominated by queue wait (a prefix-cache hit that queued behind cold
+    requests looks slower on TTFT while prefilling 4x faster), so
+    attribution splits on this instead."""
+    samples = sorted(
+        h.first_token_at - h.admitted_at
+        for h in handles
+        if h is not None
+        and h.first_token_at is not None
+        and h.admitted_at is not None
+        and pred(h)
+    )
+    if not samples:
+        return 0.0
+    return round(samples[(len(samples) - 1) // 2] * 1e3, 3)
 
 
 def run_load(engine, requests, args):
@@ -222,6 +277,25 @@ def main(argv=None) -> dict:
         warm.submit(prompt, max_new_tokens=args.max_new_tokens, seed=seed)
     warm.run_until_idle()
 
+    # cache-OFF control for the shared-prefix A/B, run BEFORE the measured
+    # engine (not after): everything downstream of the warmup is equally
+    # warm for both, so the comparison isolates the prefix cache instead of
+    # which run went second
+    no_cache = None
+    if args.shared_prefix:
+        control = make_engine(prefix_cache=0)
+        control_handles, control_wall = run_load(control, requests, args)
+        csnap = control.metrics_snapshot()
+        no_cache = {
+            "ttft_ms_p50": round(csnap["ttft_ms_p50"], 3),
+            "prefill_ms_p50": prefill_p50(control_handles),
+            "decode_tok_s": round(
+                sum(len(h.tokens) for h in control_handles if h is not None)
+                / control_wall,
+                3,
+            ),
+        }
+
     engine = make_engine(chaos_plan(args) if args.chaos else None)
     handles, wall = run_load(engine, requests, args)
 
@@ -248,19 +322,44 @@ def main(argv=None) -> dict:
     snap = engine.metrics_snapshot()
     shed = snap["shed_infeasible"] + snap["rejected_draining"]
 
+    import jax
+
+    prefix_total = snap["prefix_hits"] + snap["prefix_misses"]
     artifact = {
         "metric": f"serve_tokens_per_sec_{args.model}",
         "value": round(tokens_out / wall, 3),
         "unit": "tokens/s",
         "model": args.model,
         "mode": args.mode,
+        "workload": "shared_prefix" if args.shared_prefix else "mixed",
         "slots": args.slots,
         "requests": args.requests,
         "concurrency": min(args.concurrency, args.requests),
         "max_new_tokens": args.max_new_tokens,
         "wall_s": round(wall, 3),
+        # decode_tok_s is the regression guard's key (scripts/
+        # serve_bench_guard.py); kept alongside the legacy "value" alias
+        "decode_tok_s": round(tokens_out / wall, 3),
+        "prefill_chunk": engine.prefill_chunk,
+        "prefix_cache": {
+            "hits": snap["prefix_hits"],
+            "misses": snap["prefix_misses"],
+            "hit_rate": round(snap["prefix_hits"] / prefix_total, 4)
+            if prefix_total
+            else 0.0,
+        },
+        "prefill_ms_hit_p50": prefill_p50(handles, lambda h: h.prefix_hit_tokens > 0),
+        "prefill_ms_miss_p50": prefill_p50(handles, lambda h: h.prefix_hit_tokens == 0),
+        "no_prefix_cache": no_cache,
+        "platform": {
+            "backend": jax.default_backend(),
+            "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        },
         "ttft_ms": {q: round(snap[f"ttft_ms_{q}"], 3) for q in ("p50", "p90", "p99")},
         "itl_ms": {q: round(snap[f"itl_ms_{q}"], 3) for q in ("p50", "p90", "p99")},
+        "itl_ms_decode_only": {
+            q: round(snap[f"itl_decode_ms_{q}"], 3) for q in ("p50", "p90", "p99")
+        },
         "peak_occupancy": snap["peak_occupancy"],
         "peak_queue_depth": snap["peak_queue_depth"],
         "completed": snap["completed"],

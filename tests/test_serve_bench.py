@@ -22,6 +22,11 @@ REQUIRED_KEYS = {
     "chaos", "errors", "error_rate", "shed", "shed_rate",
     "drain_latency_s", "tick_faults", "poisoned_slots", "breaker_trips",
     "final_state",
+    # serving hot path evidence (ISSUE 4): chunked prefill, prefix caching,
+    # per-phase latency attribution, and the regression guard's keys
+    "workload", "decode_tok_s", "prefill_chunk", "prefix_cache",
+    "itl_ms_decode_only", "prefill_ms_hit_p50", "prefill_ms_miss_p50",
+    "no_prefix_cache", "platform",
 }
 
 
@@ -51,9 +56,14 @@ def test_loadgen_artifact_schema_and_invariants(tmp_path):
     assert artifact["unit"] == "tokens/s"
     assert artifact["value"] > 0
 
-    for block in ("ttft_ms", "itl_ms"):
+    for block in ("ttft_ms", "itl_ms", "itl_ms_decode_only"):
         assert set(artifact[block]) == {"p50", "p90", "p99"}
         assert artifact[block]["p50"] <= artifact[block]["p99"]
+    assert set(artifact["prefix_cache"]) == {"hits", "misses", "hit_rate"}
+    assert set(artifact["platform"]) == {"backend", "device"}
+    assert artifact["decode_tok_s"] == artifact["value"]
+    assert artifact["workload"] == "mixed"
+    assert artifact["prefill_chunk"] > 0  # chunked prefill is the default
 
     # the load-run correctness invariants the acceptance bar names
     assert artifact["completed"] == 6
@@ -87,6 +97,71 @@ def test_loadgen_chaos_run_fails_retryably_and_drains(tmp_path):
     assert artifact["mismatches"] == 0  # survivors byte-identical
     assert artifact["completed"] + artifact["errors"] == 6
     assert artifact["final_state"] == "stopped"
+
+
+def test_loadgen_shared_prefix_hits_and_parity(tmp_path):
+    """--shared-prefix: the common system prompt really hits the prefix
+    cache (hit_rate > 0), every trajectory STILL matches single-request
+    generate() byte-for-byte (reused K/V spans are bit-identical by
+    construction), and admissions that hit reach their first token FASTER
+    than the cache-off control — the TTFT win, measured on the component
+    the engine controls (admission -> first token; full TTFT under a
+    closed loop is dominated by queue wait)."""
+    loadgen = _load()
+    out = tmp_path / "BENCH_serve_prefix.json"
+    artifact = loadgen.main([
+        "--requests", "6", "--slots", "2", "--concurrency", "6",
+        "--max-new-tokens", "8", "--cache-len", "48", "--shared-prefix",
+        "--out", str(out),
+    ])
+    assert artifact["workload"] == "shared_prefix"
+    assert artifact["prefix_cache"]["hits"] > 0
+    assert artifact["prefix_cache"]["hit_rate"] > 0
+    assert artifact["verified"] is True and artifact["mismatches"] == 0
+    assert artifact["completed"] == 6 and artifact["dropped"] == 0
+    # both phases have samples: someone paid the cold prefix prefill
+    # (2+ chunk ticks) and someone skipped straight to the novel chunk
+    assert artifact["prefill_ms_miss_p50"] > 0
+    assert artifact["prefill_ms_hit_p50"] > 0
+    # the headline: a prefix hit prefills strictly less than the cache-off
+    # control's cold prefill (same workload, same seeds, same box)
+    assert artifact["no_prefix_cache"] is not None
+    assert artifact["prefill_ms_hit_p50"] < artifact["no_prefix_cache"]["prefill_ms_p50"]
+
+
+def test_serve_bench_guard_logic():
+    """The regression guard fails loudly on >15% regressions when the
+    hardware matches and skips (never fails) when it does not."""
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_guard", REPO / "scripts" / "serve_bench_guard.py"
+    )
+    guard = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(guard)
+
+    base = {
+        "decode_tok_s": 600.0, "itl_ms": {"p99": 2.0},
+        "platform": {"backend": "cpu", "device": "x"}, "workload": "mixed",
+    }
+    same = dict(base)
+    ok, _ = guard.compare(base, same)
+    assert ok
+    slow = {**base, "decode_tok_s": 400.0}
+    ok, msgs = guard.compare(base, slow)
+    assert not ok and any("decode_tok_s" in m for m in msgs)
+    tail = {**base, "itl_ms": {"p99": 5.0}}
+    ok, msgs = guard.compare(base, tail)
+    assert not ok and any("p99" in m for m in msgs)
+    # within tolerance passes
+    ok, _ = guard.compare(base, {**base, "decode_tok_s": 540.0,
+                                 "itl_ms": {"p99": 2.2}})
+    assert ok
+    # different hardware: a regression-shaped delta SKIPS instead of failing
+    other_hw = {**slow, "platform": {"backend": "tpu", "device": "v4"}}
+    ok, msgs = guard.compare(base, other_hw)
+    assert ok and any("SKIP" in m for m in msgs)
+    # pre-platform-field baselines can only skip
+    ok, msgs = guard.compare({"decode_tok_s": 600.0, "itl_ms": {"p99": 2.0}}, slow)
+    assert ok and any("SKIP" in m for m in msgs)
 
 
 def test_loadgen_request_mix_is_deterministic():

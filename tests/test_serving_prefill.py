@@ -1,0 +1,432 @@
+"""Chunked prefill / prefix cache / batched admission: the ISSUE 4 parity
+and blast-radius suite.
+
+The load-bearing claim is EQUIVALENCE: chunked prefill (and a prefix-cache
+hit mid-prompt) must be bit-for-bit identical to the one-shot prefill path —
+the logits at ``true_len - 1`` AND the full generated sequence — across
+chunk sizes, prefill-bucket boundaries, position schemes (ALiBi, RoPE,
+learned), and the int8 KV cache. The resilience interactions are pinned
+too: a fault during a prefill chunk retires ONLY the mid-prefill slots
+(decoding neighbors keep their exact trajectories), and a hot weight reload
+flushes the prefix cache so stale K/V can never serve under new weights.
+
+Everything runs the ``test`` zoo model on CPU in float32 (bitwise claims
+need a deterministic backend).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from zero_transformer_tpu.config import model_config
+from zero_transformer_tpu.inference.generate import decode_model, generate
+from zero_transformer_tpu.inference.sampling import SamplingConfig
+from zero_transformer_tpu.models import Transformer
+from zero_transformer_tpu.serving import (
+    PrefixCache,
+    ServeFault,
+    ServingChaosMonkey,
+    ServingEngine,
+)
+
+CACHE_LEN = 48
+SAMPLING = SamplingConfig(temperature=0.9, top_k=20)
+
+
+@pytest.fixture(scope="module", params=["alibi", "rope"])
+def cfg(request):
+    return model_config(
+        "test", dropout=0.0, compute_dtype="float32", position=request.param
+    )
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    # alibi and rope share a param structure (neither adds position params),
+    # so one init per cfg keeps the module fast while covering both
+    model = Transformer(cfg)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+
+@pytest.fixture(scope="module")
+def reference(cfg, params):
+    model = decode_model(cfg, CACHE_LEN)
+
+    def run(prompt, seed, max_new=8, p=params):
+        toks = generate(
+            model, p, jnp.asarray([prompt], jnp.int32), max_new,
+            jax.random.PRNGKey(seed), SAMPLING,
+        )
+        return jax.device_get(toks)[0].tolist()
+
+    return run
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("cache_len", CACHE_LEN)
+    kw.setdefault("sampling", SAMPLING)
+    return ServingEngine(cfg, params, **kw)
+
+
+def _prompt(length, offset=0):
+    return [(3 + offset + i) % 250 + 1 for i in range(length)]
+
+
+def _drive_prefill_only(engine):
+    """Admit + run chunk ticks WITHOUT any decode step, so the installed
+    per-slot logits are exactly the prefill output."""
+    engine._admit()
+    ticks = 0
+    while engine._prefilling:
+        assert engine._prefill_tick()
+        ticks += 1
+        assert ticks < 1000, "chunked prefill failed to converge"
+
+
+# ------------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("chunk", [8, 64, CACHE_LEN])
+@pytest.mark.parametrize("length", [5, 9, 17, 31])
+def test_chunk_prefill_logits_match_oneshot(cfg, params, chunk, length):
+    """The logits at ``true_len - 1`` out of chunked prefill equal the
+    one-shot padded prefill's, for prompts crossing power-of-two bucket
+    boundaries and chunks from smaller-than-prompt up to (and past —
+    64 > cache clamps) the cache capacity.
+
+    Equality bar: BITWISE for chunk=8, where multi-chunk prefill splits the
+    prompt across several narrow dispatches — proving the split itself
+    (interleaved direct cache writes, per-row offsets, window padding) adds
+    exactly nothing numerically. The cache-wide single-window chunks
+    (48/64) necessarily run a DIFFERENT XLA program shape than the one-shot
+    bucket ([S, 48] vs [1, 8..32]), and under this suite's forced 8-device
+    CPU backend (conftest) XLA tiles the wider matmuls differently —
+    1-ulp summation-order drift, identical math. Those compare at a
+    few-ulp tolerance; the token-level decode outputs (the serving
+    contract) are asserted bit-identical for EVERY chunk size in
+    ``test_chunked_sequences_match_generate``."""
+    legacy = make_engine(cfg, params)  # prefill_chunk=0: one-shot path
+    oneshot_logits, _ = legacy._prefill(_prompt(length))
+    oneshot = np.asarray(jax.device_get(oneshot_logits))[0]
+
+    chunked = make_engine(cfg, params, prefill_chunk=chunk)
+    handle = chunked.submit(_prompt(length), max_new_tokens=4, seed=0)
+    _drive_prefill_only(chunked)
+    assert handle.status == "running"
+    slot = next(
+        s for s, a in enumerate(chunked._active) if a is not None
+    )
+    got = np.asarray(jax.device_get(chunked._last_logits))[slot]
+    if chunk == 8:
+        assert np.array_equal(got, oneshot), (
+            f"chunked (chunk={chunk}) prefill logits diverge from one-shot "
+            f"for length {length}"
+        )
+    else:
+        np.testing.assert_allclose(got, oneshot, rtol=2e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("chunk", [8, 64, CACHE_LEN])
+def test_chunked_sequences_match_generate(cfg, params, reference, chunk):
+    """Full-sequence parity under real contention: 5 requests with lengths
+    crossing bucket boundaries into 2 slots, chunked engine vs
+    single-request generate()."""
+    engine = make_engine(cfg, params, prefill_chunk=chunk)
+    prompts = [_prompt(n, offset=i) for i, n in enumerate((2, 5, 9, 17, 31))]
+    handles = [
+        engine.submit(p, max_new_tokens=8, seed=i)
+        for i, p in enumerate(prompts)
+    ]
+    engine.run_until_idle()
+    for i, (p, h) in enumerate(zip(prompts, handles)):
+        assert h.status == "done", (h.status, h.error)
+        assert h.tokens == reference(p, i), f"request {i} (len {len(p)}) garbled"
+
+
+def test_chunk_window_clamp_near_capacity(cfg, params, reference):
+    """A prompt whose final chunk window would overrun the cache: the
+    engine clamps the window to ``cache_len - chunk`` and re-sends the
+    overlap, whose K/V recompute bit-identically — the trajectory must
+    still match generate() exactly."""
+    engine = make_engine(cfg, params, n_slots=1, prefill_chunk=16)
+    prompt = _prompt(39)  # fills 0/16/32 -> final window clamps to [32..48)
+    handle = engine.submit(prompt, max_new_tokens=2, seed=3)
+    engine.run_until_idle()
+    assert handle.status == "done"
+    assert handle.tokens == reference(prompt, 3, max_new=2)
+
+
+def test_prefix_cache_hit_mid_prompt_is_bit_identical(cfg, params, reference):
+    """Second request shares the first's 2-chunk system prefix: admission
+    reuses the cached spans (hits > 0, fill lands mid-prompt) and the
+    generated sequence is STILL byte-identical to single-request
+    generate() — reused K/V equals recomputed K/V."""
+    engine = make_engine(
+        cfg, params, prefill_chunk=8, prefix_cache_chunks=16
+    )
+    prefix = _prompt(16, offset=40)
+    a = engine.submit(prefix + _prompt(3, offset=7), max_new_tokens=6, seed=0)
+    engine.run_until_idle()
+    b = engine.submit(prefix + _prompt(4, offset=90), max_new_tokens=6, seed=1)
+    engine.run_until_idle()
+    assert a.status == "done" and b.status == "done"
+    assert b.prefix_hit_tokens == 16  # both prefix chunks reused
+    assert engine._prefix_cache.hits == 2
+    assert a.tokens == reference(prefix + _prompt(3, offset=7), 0, max_new=6)
+    assert b.tokens == reference(prefix + _prompt(4, offset=90), 1, max_new=6)
+    snap = engine.metrics_snapshot()
+    assert snap["prefix_hits"] == 2 and snap["prefix_hit_rate"] > 0
+
+
+def test_int8_kv_cache_chunked_parity(params):
+    """Chunked prefill through the int8 KV cache (quantized spans + scale
+    leaves ride the same slot rows) stays token-identical to generate()."""
+    qcfg = model_config(
+        "test", dropout=0.0, compute_dtype="float32", kv_cache_dtype="int8"
+    )
+    model = decode_model(qcfg, CACHE_LEN)
+    prompt = _prompt(11)
+    ref = jax.device_get(
+        generate(model, params, jnp.asarray([prompt], jnp.int32), 8,
+                 jax.random.PRNGKey(3), SAMPLING)
+    )[0].tolist()
+    engine = make_engine(qcfg, params, prefill_chunk=4, prefix_cache_chunks=8)
+    handle = engine.submit(prompt, max_new_tokens=8, seed=3)
+    engine.run_until_idle()
+    assert handle.status == "done" and handle.tokens == ref
+    # and a prefix hit over int8 spans stays exact too
+    again = engine.submit(prompt, max_new_tokens=8, seed=3)
+    engine.run_until_idle()
+    assert again.prefix_hit_tokens > 0
+    assert again.tokens == ref
+
+
+def test_learned_positions_chunked_parity():
+    """Learned absolute positions thread the per-slot decode_pos vector
+    through chunked prefill too."""
+    lcfg = model_config(
+        "test", dropout=0.0, compute_dtype="float32", position="learned"
+    )
+    lparams = Transformer(lcfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    model = decode_model(lcfg, lcfg.max_seq_len)
+    prompt = _prompt(13)
+    ref = jax.device_get(
+        generate(model, lparams, jnp.asarray([prompt], jnp.int32), 6,
+                 jax.random.PRNGKey(5), SAMPLING)
+    )[0].tolist()
+    engine = ServingEngine(
+        lcfg, lparams, n_slots=2, cache_len=lcfg.max_seq_len,
+        sampling=SAMPLING, prefill_chunk=4,
+    )
+    handle = engine.submit(prompt, max_new_tokens=6, seed=5)
+    engine.run_until_idle()
+    assert handle.status == "done" and handle.tokens == ref
+
+
+# -------------------------------------------------- batched admission
+
+
+def test_batched_admission_single_install_dispatch(cfg, params, reference):
+    """N free slots + N queued prompts admit as ONE batch: every prompt
+    progresses through the same chunk dispatches and completion installs
+    coalesce — and each trajectory still matches generate()."""
+    engine = make_engine(cfg, params, n_slots=4, prefill_chunk=8)
+    prompts = [_prompt(9, offset=i * 11) for i in range(4)]
+    handles = [
+        engine.submit(p, max_new_tokens=6, seed=i)
+        for i, p in enumerate(prompts)
+    ]
+    _drive_prefill_only(engine)
+    # all four admitted together and completed prefill in the SAME two
+    # chunk dispatches (9 tokens / chunk 8 -> 2 chunks), not 4x2
+    assert engine.stats["prefill_chunks"] == 8  # 4 slots x 2 ticks, batched
+    assert all(h.status == "running" for h in handles)
+    engine.run_until_idle()
+    for i, (p, h) in enumerate(zip(prompts, handles)):
+        assert h.tokens == reference(p, i, max_new=6)
+
+
+def test_itl_attribution_excludes_prefill_ticks(cfg, params):
+    """ITL samples from ticks that ran prefill work are excluded from the
+    pure-decode split: with staggered budgets (so retires — and therefore
+    admissions — desynchronize), some inter-token gap coincides with a
+    neighbor's chunk prefill and itl_decode_ms sees fewer samples."""
+    engine = make_engine(cfg, params, prefill_chunk=8)
+    for i in range(8):
+        engine.submit(_prompt(3, offset=i), max_new_tokens=6 + (i * 5) % 11, seed=i)
+    engine.run_until_idle()
+    assert len(engine._itl_decode) < len(engine._itl)
+    snap = engine.metrics_snapshot()
+    assert "itl_decode_ms_p99" in snap and "itl_ms_p99" in snap
+
+
+# ------------------------------------------------------- resilience paths
+
+
+@pytest.mark.chaos
+def test_prefill_fault_retires_only_the_chunk_slots(cfg, params, reference):
+    """A fault during a prefill chunk fails ONLY the mid-prefill slot
+    (retryably): the decoding neighbor's trajectory is byte-identical to an
+    undisturbed run, the breaker never opens, and the freed slot serves a
+    retry cleanly."""
+    chaos = ServingChaosMonkey([ServeFault("prefill_fault", step=4, duration=1)])
+    engine = make_engine(cfg, params, prefill_chunk=4, chaos=chaos)
+    neighbor = engine.submit(_prompt(3), max_new_tokens=12, seed=1)
+    for _ in range(4):
+        engine.step()
+    victim = engine.submit(_prompt(13, offset=50), max_new_tokens=8, seed=3)
+    engine.run_until_idle()
+    assert victim.status == "failed" and victim.retryable
+    assert "prefill chunk" in victim.error
+    assert victim.tokens == []  # failed before its first token
+    assert neighbor.status == "done"
+    assert neighbor.tokens == reference(_prompt(3), 1, max_new=12)
+    assert engine.stats["prefill_faults"] == 1
+    assert engine.stats["tick_faults"] == 0
+    assert not engine._breaker.open
+    retry = engine.submit(_prompt(13, offset=50), max_new_tokens=8, seed=3)
+    engine.run_until_idle()
+    assert retry.status == "done"
+    assert retry.tokens == reference(_prompt(13, offset=50), 3)
+
+
+def test_decode_fault_mid_chunk_fails_prefilling_retryably(cfg, params, reference):
+    """A DECODE tick fault while a prompt is mid-chunked-prefill: the
+    device rebuild invalidates the half-filled rows too, so the prefilling
+    handle fails retryably (never hangs), and the engine serves
+    byte-identical output afterwards."""
+    chaos = ServingChaosMonkey([ServeFault("tick_fault", step=4, duration=1)])
+    engine = make_engine(cfg, params, prefill_chunk=4, chaos=chaos)
+    decoding = engine.submit(_prompt(3), max_new_tokens=12, seed=1)
+    for _ in range(4):
+        engine.step()
+    midway = engine.submit(_prompt(17, offset=60), max_new_tokens=8, seed=2)
+    engine.step()  # tick 4: chunk 1 of `midway`, then the faulted decode
+    assert decoding.status == "failed" and decoding.retryable
+    assert midway.status == "failed" and midway.retryable
+    engine.run_until_idle()
+    after = engine.submit(_prompt(17, offset=60), max_new_tokens=8, seed=2)
+    engine.run_until_idle()
+    assert after.status == "done"
+    assert after.tokens == reference(_prompt(17, offset=60), 2)
+
+
+def test_reload_mid_prefill_restarts_under_new_weights(cfg, params, reference):
+    """A hot reload landing while a prompt is MID-chunked-prefill: the job
+    restarts from token zero under the new weights — its output is
+    byte-identical to generate() with the new tree, and the spans it banks
+    afterwards are pure new-weight K/V (a later shared-prefix request
+    reusing them stays exact). Without the restart, positions [0, fill)
+    keep old-weight K/V: the output mixes weights and the poisoned spans
+    land in the just-flushed prefix cache."""
+    params2 = Transformer(cfg).init(
+        jax.random.PRNGKey(9), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = make_engine(
+        cfg, params, n_slots=1, prefill_chunk=4, prefix_cache_chunks=16
+    )
+    prompt = _prompt(17, offset=25)  # 5 chunks of 4
+    mid = engine.submit(prompt, max_new_tokens=6, seed=2)
+    engine._admit()
+    engine._prefill_tick()  # chunks 1-2 computed under the OLD weights
+    engine._prefill_tick()
+    assert engine._prefilling and next(iter(engine._prefilling.values())).fill == 8
+    engine.reload_params(params2)
+    engine.run_until_idle()  # swap -> restart -> full prefill on params2
+    assert mid.status == "done"
+    new_ref = reference(prompt, 2, max_new=6, p=params2)
+    assert mid.tokens == new_ref and mid.tokens != reference(prompt, 2, max_new=6)
+    # the banked spans are new-weight: a shared-prefix follow-up that HITS
+    # them must still be byte-identical to generate() on the new tree
+    follow = engine.submit(prompt[:12] + _prompt(3, offset=70), max_new_tokens=6, seed=5)
+    engine.run_until_idle()
+    assert follow.prefix_hit_tokens > 0
+    assert follow.tokens == reference(
+        prompt[:12] + _prompt(3, offset=70), 5, max_new=6, p=params2
+    )
+
+
+def test_reload_flushes_prefix_cache(cfg, params, reference):
+    """Hot weight reload invalidates the prefix cache at the swap tick:
+    post-reload shared-prefix requests re-prefill under the NEW weights
+    (bit-identical to generate() with them) instead of reusing stale K/V."""
+    params2 = Transformer(cfg).init(
+        jax.random.PRNGKey(9), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = make_engine(cfg, params, prefill_chunk=8, prefix_cache_chunks=16)
+    prefix = _prompt(16, offset=30)
+    warm = engine.submit(prefix + _prompt(2), max_new_tokens=4, seed=0)
+    engine.run_until_idle()
+    assert warm.status == "done" and len(engine._prefix_cache) > 0
+    engine.reload_params(params2)
+    engine.step()  # the swap tick flushes
+    assert len(engine._prefix_cache) == 0
+    after = engine.submit(prefix + _prompt(3, offset=80), max_new_tokens=6, seed=4)
+    engine.run_until_idle()
+    assert after.status == "done"
+    assert after.prefix_hit_tokens == 0  # cold again: nothing stale to hit
+    new_ref = reference(prefix + _prompt(3, offset=80), 4, max_new=6, p=params2)
+    assert after.tokens == new_ref
+    assert after.tokens != reference(prefix + _prompt(3, offset=80), 4, max_new=6)
+
+
+# ------------------------------------------------------------ bucket cap
+
+
+def test_bucket_cap_bounds_compiled_prefill_programs(cfg, params, reference):
+    """Legacy one-shot path: past ``max_prefill_buckets`` distinct buckets,
+    new prompt lengths round UP to an existing bucket (exact — padded
+    prefill is causality-safe) instead of compiling another program, the
+    event is counted, and the gauge is exported."""
+    engine = make_engine(
+        cfg, params, n_slots=1, max_prefill_buckets=2
+    )
+    assert engine._bucket(3) == 8
+    assert engine._bucket(12) == 16
+    # budget spent: 24 would want bucket 32; it must round to an existing
+    # one — none fits, so the capacity bucket (always admissible) is used
+    assert engine._bucket(24) == CACHE_LEN
+    assert engine._bucket(5) == 8  # still served by the compiled 8-bucket
+    assert engine._bucket(13) == 16
+    assert engine._bucket(9) == 16  # 16 exists; no new 8->16 gap compile
+    assert engine.stats["prefill_bucket_capped"] >= 1
+    assert engine.metrics_snapshot()["prefill_buckets"] == 3  # 8, 16, cap
+    # and a request through the capped path still decodes exactly
+    handle = engine.submit(_prompt(24), max_new_tokens=4, seed=7)
+    engine.run_until_idle()
+    assert handle.tokens == reference(_prompt(24), 7, max_new=4)
+
+
+# ------------------------------------------------------------ prefix cache
+
+
+def test_prefix_cache_lru_unit():
+    """Host-side LRU semantics: chunk-aligned keys, last-chunk exclusion,
+    eviction order, flush."""
+    pc = PrefixCache(chunk_tokens=4, capacity=2)
+    p1 = list(range(1, 11))  # 10 tokens: chunks at 4 and 8
+    fill, spans = pc.lookup(p1)
+    assert fill == 0 and spans == [] and pc.misses == 2
+    pc.store(p1, 1, "span1")
+    pc.store(p1, 2, "span2")
+    fill, spans = pc.lookup(p1)
+    assert fill == 8 and spans == ["span1", "span2"] and pc.hits == 2
+    # a full-prompt-aligned lookup never consumes the final chunk: a
+    # 8-token prompt sharing p1's first 8 tokens may only reuse chunk 1
+    fill, spans = pc.lookup(p1[:8])
+    assert fill == 4 and spans == ["span1"]
+    # divergent prefix: chunk 1 differs -> no hit, and a deeper stored
+    # chunk alone is unreachable without its predecessors
+    other = [99] + p1[1:]
+    fill, spans = pc.lookup(other)
+    assert fill == 0 and spans == []
+    # eviction: capacity 2, storing a third entry evicts the LRU one
+    pc.store(other, 1, "span3")
+    assert pc.evictions == 1 and len(pc) == 2
+    assert pc.flush() == 2 and len(pc) == 0

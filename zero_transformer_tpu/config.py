@@ -397,6 +397,61 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Continuous-batching serving policy (``zero_transformer_tpu/serving/``).
+
+    The hot-path knobs ``serve --server`` exposes as flags, with the
+    defaults defined ONCE here (the CLI reads them from this dataclass so a
+    YAML deployment config and the flag surface can never drift):
+
+    - **prefill_chunk**: prompts prefill ``prefill_chunk`` tokens per
+      scheduler tick, written DIRECTLY into the slot's rows of the shared
+      KV cache and interleaved with the fused decode step — one long prompt
+      can no longer stall every active stream for its full prefill
+      (Sarathi-style chunked prefill). 0 = legacy one-shot bucketed
+      prefill (the whole prompt in one padded [1, bucket] dispatch, then a
+      cache insert).
+    - **prefix_cache_chunks**: capacity (in chunk-sized K/V spans) of the
+      chunk-aligned token-prefix LRU; repeated system prompts skip straight
+      to the first novel chunk (vLLM-style block hashing). 0 disables.
+      Requires ``prefill_chunk > 0``. Flushed on hot weight reload — cached
+      K/V is only valid for the weights that produced it.
+    - **max_prefill_buckets**: cap on DISTINCT compiled one-shot prefill
+      buckets (legacy path): past it, new prompt lengths round up to an
+      already-compiled bucket instead of compiling another program, so
+      diverse prompt lengths cannot compile-storm a serving replica.
+    """
+
+    slots: int = 4
+    max_queue: int = 64
+    prefill_chunk: int = 64
+    prefix_cache_chunks: int = 256
+    max_prefill_buckets: int = 8
+    drain_deadline_s: float = 30.0
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError("serving.slots must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError("serving.max_queue must be >= 1")
+        if self.prefill_chunk < 0:
+            raise ValueError("serving.prefill_chunk must be >= 0 (0 disables)")
+        if self.prefix_cache_chunks < 0:
+            raise ValueError(
+                "serving.prefix_cache_chunks must be >= 0 (0 disables)"
+            )
+        if self.prefix_cache_chunks > 0 and self.prefill_chunk == 0:
+            raise ValueError(
+                "serving.prefix_cache_chunks requires prefill_chunk > 0: the "
+                "prefix cache is keyed on chunk-aligned token spans"
+            )
+        if self.max_prefill_buckets < 1:
+            raise ValueError("serving.max_prefill_buckets must be >= 1")
+        if self.drain_deadline_s < 0:
+            raise ValueError("serving.drain_deadline_s must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointConfig:
     directory: str = "checkpoints"
     keep: int = 5
@@ -419,6 +474,7 @@ class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = dataclasses.field(default_factory=ResilienceConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
 
 def _build(cls, raw: dict) -> Any:
@@ -475,6 +531,7 @@ def load_config(path: str | Path, **overrides) -> Config:
         ("data", DataConfig),
         ("checkpoint", CheckpointConfig),
         ("resilience", ResilienceConfig),
+        ("serving", ServingConfig),
     ):
         if key in raw:
             sections[key] = _build(cls, raw.pop(key) or {})

@@ -294,6 +294,12 @@ def _server(gen: TextGenerator, args) -> None:
     Sampling controls come from the CLI and are ENGINE-level (baked into the
     fused decode step); requests vary prompt/budget/seed/deadline.
 
+    Hot-path defaults (docs/SERVING.md): prompts prefill CHUNKED
+    (--prefill-chunk tokens per tick, interleaved with decode so long
+    prompts never stall active streams) with a chunk-aligned prefix cache
+    (--prefix-cache) that lets repeated system prompts skip straight to
+    their first novel chunk.
+
     Resilience wiring: /healthz answers 503 until the engine is READY and
     while it drains; SIGTERM closes admission and finishes in-flight
     generations up to --drain-deadline before exiting 0; SIGHUP (or
@@ -319,6 +325,9 @@ def _server(gen: TextGenerator, args) -> None:
         mesh=gen.mesh,
         metrics=MetricsLogger(directory=args.metrics_dir),
         metrics_interval=args.metrics_interval,
+        prefill_chunk=args.prefill_chunk,
+        prefix_cache_chunks=args.prefix_cache if args.prefill_chunk else 0,
+        max_prefill_buckets=args.max_prefill_buckets,
     )
     run_server(
         engine, gen.tokenizer, host=args.host, port=args.port,
@@ -427,12 +436,35 @@ def main(argv=None) -> None:
                         "(POST /generate, GET /healthz, GET /metrics)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
-    p.add_argument("--slots", type=int, default=4,
+    from zero_transformer_tpu.config import ServingConfig
+
+    serving_defaults = ServingConfig()
+    p.add_argument("--slots", type=int, default=serving_defaults.slots,
                    help="concurrent decode slots (KV-cache rows); queued "
                         "requests admit as slots free up")
-    p.add_argument("--max-queue", type=int, default=64,
+    p.add_argument("--max-queue", type=int, default=serving_defaults.max_queue,
                    help="admission-queue depth; beyond it /generate "
                         "returns 429 (backpressure)")
+    p.add_argument("--prefill-chunk", type=int,
+                   default=serving_defaults.prefill_chunk,
+                   help="prefill this many prompt tokens per scheduler tick, "
+                        "written directly into the slot KV cache and "
+                        "interleaved with decode — a long prompt no longer "
+                        "stalls every active stream for its full prefill "
+                        "(0 = legacy one-shot bucketed prefill)")
+    p.add_argument("--prefix-cache", type=int,
+                   default=serving_defaults.prefix_cache_chunks,
+                   metavar="CHUNKS",
+                   help="capacity of the chunk-aligned token-prefix K/V "
+                        "LRU: repeated system prompts skip straight to "
+                        "their first novel chunk (0 = off; requires "
+                        "--prefill-chunk > 0; flushed on hot reload)")
+    p.add_argument("--max-prefill-buckets", type=int,
+                   default=serving_defaults.max_prefill_buckets,
+                   help="cap on distinct compiled one-shot prefill buckets "
+                        "(legacy --prefill-chunk 0 path): past it, new "
+                        "prompt lengths round up to an existing bucket "
+                        "instead of compiling another program")
     p.add_argument("--metrics-dir", default=None,
                    help="JSONL sink for serving metrics (TTFT/ITL "
                         "percentiles, tokens/s, occupancy)")
@@ -444,7 +476,8 @@ def main(argv=None) -> None:
                         "remote admin requests get 403 — weight swapping "
                         "must not be open to any peer that can reach a "
                         "--host 0.0.0.0 port)")
-    p.add_argument("--drain-deadline", type=float, default=30.0,
+    p.add_argument("--drain-deadline", type=float,
+                   default=serving_defaults.drain_deadline_s,
                    help="graceful-drain budget on SIGTERM/shutdown: "
                         "admission closes immediately (503 + Retry-After), "
                         "in-flight generations get this many seconds to "

@@ -22,6 +22,7 @@ from zero_transformer_tpu.serving.engine import (
     RequestHandle,
     ServingEngine,
 )
+from zero_transformer_tpu.serving.prefix_cache import PrefixCache
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
     DRAINING,
@@ -45,6 +46,7 @@ __all__ = [
     "STOPPED",
     "CircuitBreaker",
     "Lifecycle",
+    "PrefixCache",
     "ReloadError",
     "ServeFault",
     "ServingChaosMonkey",

@@ -5,10 +5,20 @@
 
 - requests queue behind a bounded admission queue (backpressure: a full
   queue REJECTS at submit time rather than stacking unbounded latency);
-- free slots admit queued requests: the prompt prefills into a fresh
-  single-row cache (padded to a power-of-two bucket so prompt length never
-  changes the jit signature), then ``SlotKVCache.insert`` copies it into the
-  slot;
+- free slots admit queued requests. With ``prefill_chunk > 0`` (the serving
+  default) the prompt prefills CHUNKED: ``prefill_chunk`` tokens per tick,
+  written directly into the slot's rows of the shared ``SlotKVCache`` by one
+  fixed-shape ``[n_slots, chunk]`` program that advances EVERY mid-prefill
+  slot at once — so a long prompt never stalls active streams for its full
+  prefill (the Sarathi-Serve interleaving), multiple queued prompts prefill
+  as one batch (admission is inherently batched), and there is no
+  small-cache-then-insert copy or per-prompt-length compile. A chunk-aligned
+  token-prefix LRU (``serving/prefix_cache.py``) lets repeated system
+  prompts skip straight to the first novel chunk. ``prefill_chunk = 0``
+  keeps the legacy one-shot path: the prompt prefills into a fresh
+  single-row cache (padded to a power-of-two bucket, count-capped so
+  diverse lengths cannot compile-storm the replica), then
+  ``SlotKVCache.insert`` copies it into the slot;
 - every ``step()`` runs ONE fused decode step across all slots — padded and
   masked so the compiled program is identical whatever the occupancy — then
   retires slots that hit EOS, their token budget, a deadline, or a
@@ -66,6 +76,7 @@ from zero_transformer_tpu.inference.generate import (
 )
 from zero_transformer_tpu.inference.sampling import SamplingConfig, sample_token
 from zero_transformer_tpu.resilience.detect import nonfinite_rows
+from zero_transformer_tpu.serving.prefix_cache import PrefixCache
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
     DRAINING,
@@ -78,7 +89,7 @@ from zero_transformer_tpu.serving.resilience import (
     infeasible_deadline,
     validate_reload,
 )
-from zero_transformer_tpu.serving.slots import SlotKVCache
+from zero_transformer_tpu.serving.slots import INDEX_LEAVES, SlotKVCache, _leaf_name
 
 # request terminal states
 QUEUED = "queued"
@@ -122,6 +133,14 @@ class RequestHandle:
         # Retry-After; invalid requests stay non-retryable 400s
         self.retryable = False
         self.retry_after: Optional[float] = None
+        # how many prompt tokens a prefix-cache hit covered at admission
+        # (0 = cold/miss/disabled) — the loadgen splits TTFT by this
+        self.prefix_hit_tokens = 0
+        # when the request left the queue for a slot: first_token_at minus
+        # this is the prefill+first-decode latency the ENGINE controls
+        # (TTFT minus queue wait), the clean denominator for prefix-cache
+        # attribution under load
+        self.admitted_at: Optional[float] = None
         self._events: queue_mod.Queue = queue_mod.Queue()
         self._done = threading.Event()
         self._cancel = threading.Event()
@@ -198,6 +217,17 @@ class _ActiveSlot:
     last_emit_at: Optional[float] = None
 
 
+@dataclasses.dataclass
+class _PrefillJob:
+    """A slot mid-chunked-prefill: acquired in the SlotKVCache but not yet
+    decoding. ``fill`` counts prompt tokens whose K/V are in the slot's
+    rows (prefix-cache hits included); prefill completes when it reaches
+    the prompt length and the slot installs into the decode set."""
+
+    handle: RequestHandle
+    fill: int = 0
+
+
 def _percentiles(values: Sequence[float], qs=(50, 90, 99)) -> Dict[str, float]:
     """Nearest-rank percentiles of a host-side sample list (no numpy dance —
     sample counts are small and this must be dependency-free). ceil, not
@@ -255,6 +285,133 @@ def _jit_fused_step():
 _FUSED_SHARED = _jit_fused_step()
 
 
+def _slice_rows(leaf, ax, offsets, length):
+    """Per-row gather of ``length`` sequence positions at each row's own
+    offset: leaf [..., S@ax, L@ax+1, ...] -> [S, ..., length, ...] (slot
+    axis moved to the front so vmap can pair rows with offsets)."""
+    v = jnp.moveaxis(leaf, ax, 0)
+    # inside the vmapped row the slot axis is gone, so the sequence axis
+    # (originally ax + 1) sits at index ax
+    return jax.vmap(
+        lambda row, o: jax.lax.dynamic_slice_in_dim(row, o, length, axis=ax)
+    )(v, offsets)
+
+
+def _write_rows(leaf, regions, ax, offsets):
+    """Inverse of ``_slice_rows``: scatter per-row regions back at each
+    row's offset and restore the original axis order."""
+    v = jnp.moveaxis(leaf, ax, 0)
+    v = jax.vmap(
+        lambda row, r, o: jax.lax.dynamic_update_slice_in_dim(row, r, o, axis=ax)
+    )(v, regions, offsets)
+    return jnp.moveaxis(v, 0, ax)
+
+
+def _chunk_prefill_impl(model, axes_items, params, cache, tokens, starts, true_lens, active):
+    """One prefill chunk for EVERY mid-prefill slot, written directly into
+    the shared slot cache — the fixed-shape [S, C] program at the heart of
+    chunked prefill + batched admission.
+
+    Per row: ``tokens`` holds the prompt window at global positions
+    ``[starts, starts + C)`` (zero-padded past the prompt; the host clamps
+    ``starts`` to ``cache_len - C`` and re-sends earlier tokens in the
+    window, whose K/V recompute bit-identically, so the window never
+    clamps inside ``dynamic_update_slice``). The model's per-slot decode
+    path does the rest: vector cache index = per-row write offset, per-row
+    RoPE/ALiBi positions, causal masking against ``q_offset`` so real
+    query positions never attend to the window's padded tail.
+
+    Rows NOT mid-prefill (parked or actively decoding) ride along because
+    the program's shape is fixed: their clobbered K/V window and index
+    cursor are stashed first and restored bit-exactly after the apply, so
+    the dispatch is invisible to them. The cache argument is deliberately
+    NOT donated: on a fault the engine keeps the pre-chunk cache and fails
+    only the prefilling slots (``_on_prefill_fault``) — decode slots
+    survive untouched, at the cost of the apply writing fresh buffers.
+
+    Returns ``(cache, last_logits)`` where ``last_logits[s]`` is the f32
+    logits row at the prompt's final position — meaningful only for rows
+    whose prefill completes in this chunk (``true_lens`` falls inside the
+    window); the engine installs exactly those rows.
+    """
+    axes = dict(axes_items)
+    S, C = tokens.shape
+
+    saved_regions: Dict[str, jax.Array] = {}
+    saved_index: Dict[str, jax.Array] = {}
+
+    def collect(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if _leaf_name(path) in INDEX_LEAVES:
+            saved_index[key] = leaf
+        elif key in axes:
+            saved_regions[key] = _slice_rows(leaf, axes[key], starts, C)
+
+    jax.tree_util.tree_map_with_path(collect, cache)
+
+    def set_index(path, leaf):
+        if _leaf_name(path) in INDEX_LEAVES:
+            return jnp.broadcast_to(starts.astype(leaf.dtype), leaf.shape)
+        return leaf
+
+    cache = jax.tree_util.tree_map_with_path(set_index, cache)
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache}, tokens, mutable=["cache"]
+    )
+    new_cache = vars_out["cache"]
+
+    # logits at the prompt's last position, per row (clip keeps the gather
+    # in-bounds for rows whose prompt does not end in this window — their
+    # value is garbage the engine never reads)
+    last = jax.vmap(
+        lambda row, i: jax.lax.dynamic_slice_in_dim(row, i, 1, axis=0)[0]
+    )(logits, jnp.clip(true_lens - 1 - starts, 0, C - 1)).astype(jnp.float32)
+
+    new_fill = jnp.minimum(starts + C, true_lens)
+
+    def fix(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if _leaf_name(path) in INDEX_LEAVES:
+            # active rows: fill cursor = min(window end, prompt length) —
+            # the padded tail of a final chunk stays outside the validity
+            # mask exactly like the legacy padded prefill. Inactive rows:
+            # their pre-chunk cursor, bit-exact. (broadcast from the right:
+            # leaf is [..., S])
+            return jnp.where(active, new_fill.astype(leaf.dtype), saved_index[key])
+        ax = axes.get(key)
+        if ax is None:
+            return leaf
+        region = _slice_rows(leaf, ax, starts, C)
+        keep = active.reshape((S,) + (1,) * (region.ndim - 1))
+        return _write_rows(
+            leaf, jnp.where(keep, region, saved_regions[key]), ax, starts
+        )
+
+    return jax.tree_util.tree_map_with_path(fix, new_cache), last
+
+
+# shared like _FUSED_SHARED: the statics (model structure, cache axes map)
+# compare equal across engines, so warmup engines pre-pay this compile too.
+# ONE compiled program per (n_slots, chunk) whatever the prompt-length mix —
+# chunked prefill has no per-length bucket family to storm.
+_CHUNK_SHARED = jax.jit(_chunk_prefill_impl, static_argnums=(0, 1))
+
+
+@jax.jit
+def _install_rows(last_logits, gen_mask, rngs, mask, logits_rows, keys):
+    """Install every completed prefill in ONE dispatch: rows under ``mask``
+    get their prefill logits, a cleared penalty mask, and a fresh rng
+    chain; other rows pass through untouched. Replaces the per-request
+    ``dynamic_update_slice`` install — admission cost no longer scales
+    dispatches with the number of requests admitted in a tick."""
+    m = mask[:, None]
+    return (
+        jnp.where(m, logits_rows, last_logits),
+        jnp.where(m, jnp.zeros_like(gen_mask), gen_mask),
+        jnp.where(m, keys, rngs),
+    )
+
+
 class ServingEngine:
     """Slot-scheduled continuous batching over one jitted decode step.
 
@@ -284,9 +441,27 @@ class ServingEngine:
         shed_warmup: int = 8,
         itl_decay: float = 0.9,
         chaos=None,
+        prefill_chunk: int = 0,
+        prefix_cache_chunks: int = 0,
+        max_prefill_buckets: int = 8,
     ):
         self.cfg = cfg
         self.cache_len = cache_len or cfg.max_seq_len
+        if prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 = one-shot prefill)")
+        if prefix_cache_chunks < 0:
+            raise ValueError("prefix_cache_chunks must be >= 0 (0 disables)")
+        if prefix_cache_chunks > 0 and prefill_chunk == 0:
+            raise ValueError(
+                "prefix caching requires chunked prefill (prefill_chunk > 0): "
+                "entries are keyed on chunk-aligned token spans"
+            )
+        if max_prefill_buckets < 1:
+            raise ValueError("max_prefill_buckets must be >= 1")
+        # a chunk larger than the cache degenerates to one-shot-sized
+        # windows; clamp so the window math never exceeds capacity
+        self.prefill_chunk = min(prefill_chunk, self.cache_len)
+        self.max_prefill_buckets = max_prefill_buckets
         self.model = decode_model(cfg, self.cache_len)
         self.params = params
         self.sampling = sampling
@@ -303,6 +478,21 @@ class ServingEngine:
         self._gen_mask = jnp.zeros((n_slots, V), jnp.bool_)
         self._rngs = jnp.stack([jax.random.PRNGKey(0)] * n_slots)
         self._active: List[Optional[_ActiveSlot]] = [None] * n_slots
+        # slot -> _PrefillJob for slots mid-chunked-prefill (acquired in the
+        # SlotKVCache, not yet decoding); only the tick thread touches it
+        self._prefilling: Dict[int, _PrefillJob] = {}
+        self._prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.prefill_chunk, prefix_cache_chunks)
+            if self.prefill_chunk and prefix_cache_chunks
+            else None
+        )
+        self._chunk_fused = _CHUNK_SHARED
+        # distinct one-shot prefill bucket lengths this engine has compiled
+        # (legacy path); bounded by max_prefill_buckets + the capacity bucket
+        self._buckets_seen: set = set()
+        # did THIS tick do prefill work (chunk, span copy, or one-shot
+        # admission)? classifies the tick's ITL samples for attribution
+        self._prefill_work = False
 
         self._queue: deque = deque()
         self.max_queue = max_queue
@@ -328,11 +518,13 @@ class ServingEngine:
         self._drain_deadline: Optional[float] = None
         self._drain_started: Optional[float] = None
         self.drain_latency_s: Optional[float] = None
-        # one zeroed single-row cache, built once: prefill's apply is
-        # functional (never mutates its input), so every admission reuses
-        # this template instead of paying an eval_shape retrace + a fresh
-        # device allocation per request
-        self._prefill_cache = init_cache(self.model, 1, mesh=mesh)
+        # one zeroed single-row cache for the LEGACY one-shot path, built
+        # lazily on first use: prefill's apply is functional (never mutates
+        # its input), so every admission reuses this template instead of
+        # paying an eval_shape retrace + a fresh device allocation per
+        # request; the chunked path writes straight into the slot cache and
+        # never needs it
+        self._prefill_cache = None
 
         # serving counters / latency samples (host side)
         self.stats: Dict[str, Any] = {
@@ -357,6 +549,12 @@ class ServingEngine:
             "drain_forced": 0,
             "reloads": 0,
             "reloads_rejected": 0,
+            # prefill-path counters (chunked prefill / prefix cache /
+            # legacy bucket cap)
+            "prefill_chunks": 0,
+            "prefill_faults": 0,
+            "prefill_bucket_capped": 0,
+            "expired_prefilling": 0,
         }
         # bounded: an unbounded all-time sample list on a long-lived server
         # is a slow memory leak AND makes every /metrics snapshot pay an
@@ -364,6 +562,10 @@ class ServingEngine:
         # the operationally useful ones anyway
         self._ttft: deque = deque(maxlen=10_000)
         self._itl: deque = deque(maxlen=10_000)
+        # ITL samples from ticks that did NO prefill work — the pure-decode
+        # floor; the gap between itl and itl_decode percentiles IS the
+        # prefill interference the chunk budget exists to bound
+        self._itl_decode: deque = deque(maxlen=10_000)
         self._started = self.now()
 
     # ------------------------------------------------------------- admission
@@ -477,14 +679,30 @@ class ServingEngine:
 
     def _bucket(self, length: int) -> int:
         """Smallest power-of-two >= length (floor 8) that the cache admits —
-        one compiled prefill per bucket instead of one per prompt length."""
+        one compiled prefill per bucket instead of one per prompt length.
+
+        The distinct-bucket count is CAPPED (``max_prefill_buckets``): each
+        compiled bucket is a whole XLA program held for the replica's
+        lifetime, so unbounded prompt-length diversity would otherwise
+        compile-storm a long-lived server. Past the cap, new lengths round
+        UP to the smallest already-compiled bucket that fits (worst case
+        the capacity bucket — always admissible) and the event is counted
+        (``prefill_bucket_capped``) so the storm is visible in /metrics
+        instead of silent."""
         cap = self.cache_len
         if self.cfg.position == "learned":
             cap = min(cap, self.cfg.max_seq_len)
         b = 8
         while b < length:
             b *= 2
-        return min(b, cap)
+        b = min(b, cap)
+        if b not in self._buckets_seen:
+            if len(self._buckets_seen) >= self.max_prefill_buckets:
+                self.stats["prefill_bucket_capped"] += 1
+                fitting = [x for x in self._buckets_seen if x >= length]
+                b = min(fitting) if fitting else cap
+            self._buckets_seen.add(b)  # cap bucket may exceed the budget by 1
+        return b
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _prefill_padded(model, params, padded, cache, true_len):  # noqa: N805
@@ -501,6 +719,8 @@ class ServingEngine:
         return last[:, 0].astype(jnp.float32), vars_out["cache"]
 
     def _prefill(self, prompt: Sequence[int]):
+        if self._prefill_cache is None:
+            self._prefill_cache = init_cache(self.model, 1, mesh=self.mesh)
         T = len(prompt)
         bucket = self._bucket(T)
         padded = jnp.asarray(
@@ -516,70 +736,248 @@ class ServingEngine:
             jnp.int32(T),
         )
 
-    # ----------------------------------------------------------- fused tick
-
-    @jax.jit
-    def _install_row(last_logits, gen_mask, rngs, slot, logits_row, key):  # noqa: N805
-        """Write one admitted request's per-slot state (prefill logits,
-        cleared penalty mask, fresh rng chain) into row ``slot``."""
-        last_logits = jax.lax.dynamic_update_slice(
-            last_logits, logits_row[None], (slot, 0)
-        )
-        gen_mask = jax.lax.dynamic_update_slice(
-            gen_mask,
-            jnp.zeros((1, gen_mask.shape[1]), gen_mask.dtype),
-            (slot, 0),
-        )
-        rngs = jax.lax.dynamic_update_slice(rngs, key[None], (slot, 0))
-        return last_logits, gen_mask, rngs
-
     # -------------------------------------------------------------- schedule
 
+    def _pop_queue(self) -> Optional[RequestHandle]:
+        """Pop the next admissible queued handle, finishing cancelled /
+        expired ones on the way; None when nothing is admissible."""
+        with self._lock:
+            now = self.now()
+            while self._queue:
+                cand = self._queue.popleft()
+                if cand._cancel.is_set():
+                    self.stats["cancelled"] += 1
+                    cand._finish(CANCELLED, now)
+                elif cand.request.deadline is not None and now > cand.request.deadline:
+                    self.stats["expired_queued"] += 1
+                    cand._finish(EXPIRED, now, error="deadline expired in queue")
+                else:
+                    return cand
+        return None
+
     def _admit(self) -> None:
+        if self.prefill_chunk:
+            self._admit_chunked()
+        else:
+            self._admit_oneshot()
+
+    def _admit_chunked(self) -> None:
+        """Claim a slot per admissible queued request and start its chunked
+        prefill. Prefix-cache hits copy their chunk-aligned K/V spans into
+        the slot's rows here, so the chunk loop starts at the first NOVEL
+        chunk; the chunk forwards themselves happen in ``_prefill_tick``,
+        shared across every mid-prefill slot — admission of N requests is
+        one batch, not N prefills."""
         while self.slots.free_count:
-            with self._lock:
-                handle = None
-                now = self.now()
-                while self._queue:
-                    cand = self._queue.popleft()
-                    if cand._cancel.is_set():
-                        self.stats["cancelled"] += 1
-                        cand._finish(CANCELLED, now)
-                    elif cand.request.deadline is not None and now > cand.request.deadline:
-                        self.stats["expired_queued"] += 1
-                        cand._finish(EXPIRED, now, error="deadline expired in queue")
-                    else:
-                        handle = cand
-                        break
+            handle = self._pop_queue()
             if handle is None:
                 return
+            slot = self.slots.acquire()
+            fill = 0
             try:
-                logits_row, small_cache = self._prefill(handle.request.prompt)
-                slot = self.slots.acquire()
-                self.slots.insert(small_cache, slot, len(handle.request.prompt))
-                self._last_logits, self._gen_mask, self._rngs = _in_mesh(
-                    self.mesh,
-                    ServingEngine._install_row,
-                    self._last_logits,
-                    self._gen_mask,
-                    self._rngs,
-                    jnp.int32(slot),
-                    logits_row[0],
-                    jax.random.PRNGKey(handle.request.seed),
-                )
+                if self._prefix_cache is not None:
+                    fill, spans = self._prefix_cache.lookup(handle.request.prompt)
+                    if spans:
+                        # all hit chunks land in one dispatch — a deep hit
+                        # must not cost one dispatch per chunk it skipped
+                        self.slots.write_spans(spans, slot)
+                        self._prefill_work = True
             except Exception as exc:
-                # the popped handle is in neither the queue nor _active, so
-                # _abort() cannot reach it — finish it HERE or its client
-                # hangs forever while everyone else gets a clean failure
+                # the popped handle is in neither the queue nor any slot
+                # table yet, so _abort() cannot reach it — finish it HERE
                 handle._finish(
                     FAILED, self.now(), error=f"admission failed: {exc!r}"
                 )
                 raise
+            handle.prefix_hit_tokens = fill
+            handle.admitted_at = self.now()
             handle.status = RUNNING
-            self._active[slot] = _ActiveSlot(handle)
+            self._prefilling[slot] = _PrefillJob(handle, fill=fill)
+
+    def _admit_oneshot(self) -> None:
+        """Legacy one-shot path (``prefill_chunk=0``): per-request bucketed
+        prefill + cache insert, with the install dispatches for EVERYTHING
+        admitted this pass coalesced into one ``_install_rows`` call."""
+        installs: List[tuple] = []
+        try:
+            while self.slots.free_count:
+                handle = self._pop_queue()
+                if handle is None:
+                    return
+                handle.admitted_at = self.now()
+                try:
+                    logits_row, small_cache = self._prefill(handle.request.prompt)
+                    slot = self.slots.acquire()
+                    self.slots.insert(
+                        small_cache, slot, len(handle.request.prompt)
+                    )
+                except Exception as exc:
+                    # the popped handle is in neither the queue nor _active,
+                    # so _abort() cannot reach it — finish it HERE or its
+                    # client hangs forever while everyone else gets a clean
+                    # failure
+                    handle._finish(
+                        FAILED, self.now(), error=f"admission failed: {exc!r}"
+                    )
+                    raise
+                handle.status = RUNNING
+                self._active[slot] = _ActiveSlot(handle)
+                installs.append(
+                    (slot, logits_row[0], jax.random.PRNGKey(handle.request.seed))
+                )
+                self.stats["peak_occupancy"] = max(
+                    self.stats["peak_occupancy"], self.active_count
+                )
+        finally:
+            # the finally matters: admissions that succeeded BEFORE a failed
+            # one must still install, or their slots decode from stale row
+            # state next tick
+            if installs:
+                self._prefill_work = True
+                self._flush_installs(installs)
+
+    def _flush_installs(self, installs: List[tuple]) -> None:
+        """One ``_install_rows`` dispatch for [(slot, logits_row, key), ...]."""
+        mask = [False] * self.n_slots
+        zero_row = jnp.zeros((self.cfg.vocab_size,), jnp.float32)
+        zero_key = jnp.zeros((2,), jnp.uint32)
+        rows = [zero_row] * self.n_slots
+        keys = [zero_key] * self.n_slots
+        for slot, row, key in installs:
+            mask[slot], rows[slot], keys[slot] = True, row, key
+        self._last_logits, self._gen_mask, self._rngs = _in_mesh(
+            self.mesh,
+            _install_rows,
+            self._last_logits,
+            self._gen_mask,
+            self._rngs,
+            jnp.asarray(mask, jnp.bool_),
+            jnp.stack(rows),
+            jnp.stack(keys),
+        )
+
+    # ------------------------------------------------------- chunked prefill
+
+    def _prefill_tick(self) -> bool:
+        """Process ONE chunk for every mid-prefill slot in a single
+        fixed-shape [n_slots, chunk] dispatch, then install the slots whose
+        prompt completed (their decode starts this same tick, exactly as
+        the legacy path's would). Supervised: a fault fails ONLY the
+        prefilling slots — the chunk program does not donate the cache, so
+        decoding slots keep their buffers and the tick proceeds to a
+        normal fused decode."""
+        if not self._prefilling:
+            return False
+        self._prefill_work = True
+        C, L, S = self.prefill_chunk, self.cache_len, self.n_slots
+        tokens = [[0] * C for _ in range(S)]
+        starts = [0] * S
+        lens = [0] * S
+        active = [False] * S
+        for slot, job in self._prefilling.items():
+            prompt = job.handle.request.prompt
+            # clamp the window to capacity: the final chunk of a prompt
+            # ending near the cap re-sends a few earlier tokens (their K/V
+            # recompute bit-identically — the forward is deterministic)
+            # instead of letting the device write clamp out of alignment
+            w = min(job.fill, L - C)
+            window = prompt[w : w + C]
+            tokens[slot][: len(window)] = [int(t) for t in window]
+            starts[slot], lens[slot], active[slot] = w, len(prompt), True
+        try:
+            if self._chaos is not None:
+                self._chaos.on_prefill_chunk(self._tick)
+            cache, last = _in_mesh(
+                self.mesh,
+                self._chunk_fused,
+                self.model,
+                self.slots.axes_items,
+                self.params,
+                self.slots.cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(starts, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                jnp.asarray(active, jnp.bool_),
+            )
+        except Exception as exc:
+            self._on_prefill_fault(exc)
+            return True
+        self.slots.cache = cache
+        self.stats["prefill_chunks"] += sum(active)
+        completed = []
+        for slot, job in self._prefilling.items():
+            job.fill = min(starts[slot] + C, lens[slot])
+            if job.fill >= lens[slot]:
+                completed.append((slot, job))
+        if completed:
+            self._install_completed(completed, last)
+        return True
+
+    def _install_completed(self, completed, last_rows) -> None:
+        """Move slots whose prefill just finished into the decode set (one
+        coalesced install), then bank their chunk-aligned prefix spans so
+        the NEXT prompt sharing the prefix skips them."""
+        mask = [False] * self.n_slots
+        zero_key = jnp.zeros((2,), jnp.uint32)
+        keys = [zero_key] * self.n_slots
+        for slot, job in completed:
+            mask[slot] = True
+            keys[slot] = jax.random.PRNGKey(job.handle.request.seed)
+        self._last_logits, self._gen_mask, self._rngs = _in_mesh(
+            self.mesh,
+            _install_rows,
+            self._last_logits,
+            self._gen_mask,
+            self._rngs,
+            jnp.asarray(mask, jnp.bool_),
+            last_rows,
+            jnp.stack(keys),
+        )
+        for slot, job in completed:
+            del self._prefilling[slot]
+            self._active[slot] = _ActiveSlot(job.handle)
             self.stats["peak_occupancy"] = max(
                 self.stats["peak_occupancy"], self.active_count
             )
+            if self._prefix_cache is not None:
+                # store BEFORE the first decode write: positions [0, T) are
+                # all real prompt K/V right now. One extraction dispatch
+                # covers every chunk-aligned span (the per-chunk version
+                # put n_chunks dispatches on the cold request's
+                # admission->first-token path); skipped entirely when the
+                # cache already holds the full aligned prefix.
+                prompt = job.handle.request.prompt
+                C = self.prefill_chunk
+                n_chunks = len(prompt) // C
+                if n_chunks and not all(
+                    self._prefix_cache.contains(prompt, j)
+                    for j in range(1, n_chunks + 1)
+                ):
+                    spans = self.slots.extract_spans(slot, C, n_chunks)
+                    for j, span in enumerate(spans, start=1):
+                        self._prefix_cache.store(prompt, j, span)
+
+    def _on_prefill_fault(self, exc: Exception) -> None:
+        """A chunk-prefill dispatch failed: fail ONLY the slots mid-prefill
+        (retryable error to those clients) and keep everything else — the
+        chunk program never donates the cache, so the pre-chunk buffers
+        (including every decoding slot's rows) are intact and nothing needs
+        a rebuild. Unlike decode faults this does not feed the breaker:
+        blast radius is per-request and bounded, and the shared decode
+        executable was never implicated."""
+        self.stats["prefill_faults"] += 1
+        now = self.now()
+        failed = sorted(self._prefilling)
+        for slot in failed:
+            job = self._prefilling.pop(slot)
+            job.handle._finish(
+                FAILED,
+                now,
+                error=f"prefill chunk failed (retryable): {exc!r}",
+                retryable=True,
+            )
+        self.slots.release(failed)
+        self._event("prefill_fault", error=repr(exc), slots_failed=len(failed))
 
     def _retire(self, finished: List[int]) -> None:
         self.slots.release(finished)
@@ -606,6 +1004,29 @@ class ServingEngine:
                 act.handle._finish(EXPIRED, now, error="deadline expired mid-decode")
                 finished.append(slot)
         self._retire(finished)
+        # mid-prefill slots honor cancel/deadline at the same tick boundary
+        dropped = []
+        for slot, job in self._prefilling.items():
+            if job.handle._cancel.is_set():
+                self.stats["cancelled"] += 1
+                job.handle._finish(CANCELLED, now)
+            elif (
+                job.handle.request.deadline is not None
+                and now > job.handle.request.deadline
+            ):
+                # its own counter, not expired_decoding: an operator tuning
+                # against prefill-phase expiries (prompt length vs chunk
+                # budget) must not be steered at decode budgets
+                self.stats["expired_prefilling"] += 1
+                job.handle._finish(
+                    EXPIRED, now, error="deadline expired during prefill"
+                )
+            else:
+                continue
+            dropped.append(slot)
+        for slot in dropped:
+            del self._prefilling[slot]
+        self.slots.release(dropped)
 
     def _sweep_queue(self) -> None:
         """Finish cancelled / past-deadline requests still WAITING, every
@@ -627,18 +1048,26 @@ class ServingEngine:
             self._queue = kept
 
     def step(self) -> bool:
-        """One scheduler tick: swap-in reload, sweep, admit, supervised fused
+        """One scheduler tick: swap-in reload, sweep, admit, chunk-prefill
+        budget (one chunk per mid-prefill slot, batched), supervised fused
         decode, emit, retire. Returns False when there was nothing to do."""
         self._swap_pending_params()
         self._sweep_queue()
         self._sweep_active()
+        self._prefill_work = False
         self._admit()
+        ran_prefill = self._prefill_tick() if self.prefill_chunk else False
         # an idle DEGRADED engine still runs the fused step as a self-probe
         # (all rows parked, outputs discarded): without it, a load balancer
         # honoring the 503 starves the engine of the clean tick it needs to
         # close the breaker, and the replica would stay DEGRADED forever
         probe = self._breaker.open and self.active_count == 0
         if self.active_count == 0 and not probe:
+            if ran_prefill:
+                # prefill-only tick: nothing decodes yet, but the tick did
+                # real work and the loop must not sleep
+                self._tick += 1
+                return True
             return False
 
         # -- supervised region: a fault here poisons AT MOST this tick's
@@ -732,6 +1161,11 @@ class ServingEngine:
             with self._lock:
                 self._ttft.extend(ttft_new)
                 self._itl.extend(itl_new)
+                if not self._prefill_work:
+                    # per-phase attribution: this tick ran no prefill work
+                    # (chunk, span copy, or one-shot admission), so these
+                    # samples are the pure-decode ITL floor
+                    self._itl_decode.extend(itl_new)
             for sample in itl_new:
                 self._itl_ewma.update(sample)
         self._retire(finished)
@@ -774,6 +1208,17 @@ class ServingEngine:
             # killing the scheduler; _rebuild_device_state below replaces
             # the whole SlotKVCache (free list included) instead
             self._active[slot] = None
+        # mid-prefill slots die with the tick too: the rebuild below
+        # replaces the cache tree their half-filled rows live in (the
+        # donating decode step made every shared buffer suspect)
+        for slot in sorted(self._prefilling):
+            job = self._prefilling.pop(slot)
+            job.handle._finish(
+                FAILED, now,
+                error=f"decode tick failed (retryable): {exc!r}",
+                retryable=True,
+            )
+            failed.append(slot)
         self._event("tick_fault", error=repr(exc), slots_failed=len(failed))
         if self._breaker.record_fault():
             self.stats["breaker_trips"] += 1
@@ -815,7 +1260,14 @@ class ServingEngine:
         self._gen_mask = jnp.zeros((self.n_slots, V), jnp.bool_)
         self._rngs = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
         self._active = [None] * self.n_slots
-        self._prefill_cache = init_cache(self.model, 1, mesh=self.mesh)
+        self._prefilling.clear()
+        self._prefill_cache = None  # legacy template reallocates lazily
+        if self._prefix_cache is not None:
+            # conservative: cached spans were extracted from earlier, clean
+            # ticks and are independent buffers, but re-deriving which
+            # survived a faulted tick is not worth wrong K/V if the
+            # reasoning ever rots — cold misses rebuild the cache
+            self._prefix_cache.flush()
         self._event("engine_rebuilt")
 
     # ----------------------------------------------------------------- drain
@@ -857,7 +1309,11 @@ class ServingEngine:
         if not self.draining:
             return self.lifecycle.state == STOPPED
         now = self.now()
-        if self.active_count == 0 and self.queue_depth == 0:
+        if (
+            self.active_count == 0
+            and not self._prefilling
+            and self.queue_depth == 0
+        ):
             self._finish_drain(forced=0)
             return True
         if self._drain_deadline is not None and now > self._drain_deadline:
@@ -869,8 +1325,18 @@ class ServingEngine:
                     retryable=True,
                 )
             self._retire(forced)
-            self.stats["drain_forced"] += len(forced)
-            self._finish_drain(forced=len(forced))
+            still_prefilling = sorted(self._prefilling)
+            for slot in still_prefilling:
+                job = self._prefilling.pop(slot)
+                job.handle._finish(
+                    FAILED, now,
+                    error="drain deadline exceeded; generation force-finished",
+                    retryable=True,
+                )
+            self.slots.release(still_prefilling)
+            forced_total = len(forced) + len(still_prefilling)
+            self.stats["drain_forced"] += forced_total
+            self._finish_drain(forced=forced_total)
             return True
         return False
 
@@ -951,6 +1417,26 @@ class ServingEngine:
             return
         self.params, swap_event = pending
         self.stats["reloads"] += 1
+        if self._prefix_cache is not None:
+            # invalidation-on-reload: cached K/V spans embody the OLD
+            # weights — serving them under the new tree would garble every
+            # shared-prefix request. Flushed at the same tick boundary the
+            # params flip, so no tick ever mixes the two.
+            flushed = self._prefix_cache.flush()
+            if flushed:
+                self._event("prefix_cache_flushed", entries=flushed)
+        # slots MID-chunked-prefill restart from token zero: their rows
+        # hold old-weight K/V for [0, fill), and finishing the prompt under
+        # the new tree would (a) decode from weight-mixed prompt K/V and
+        # (b) bank those mixed spans into the just-flushed prefix cache,
+        # poisoning every later shared-prefix request. Re-prefilling a few
+        # chunks on a rare admin event is cheap; the request then matches
+        # generate() under the NEW weights exactly. (Decoding slots keep
+        # the PR 3 contract: they continue on the new weights from their
+        # next token, nothing retires.)
+        for job in self._prefilling.values():
+            job.fill = 0
+            job.handle.prefix_hit_tokens = 0
         swap_event.set()
         self._event("reload_swapped", reloads=self.stats["reloads"])
 
@@ -1000,6 +1486,8 @@ class ServingEngine:
             if act is not None:
                 act.handle._finish(FAILED, now, error=reason)
                 self._active[slot] = None
+        for slot in sorted(self._prefilling):
+            self._prefilling.pop(slot).handle._finish(FAILED, now, error=reason)
 
     def run_until_idle(self, max_ticks: int = 100_000) -> None:
         """Drive the scheduler synchronously until queue and slots drain
@@ -1022,10 +1510,27 @@ class ServingEngine:
             "uptime_s": self.lifecycle.uptime_s,
             "breaker_open": self._breaker.open,
             "itl_ewma_ms": (self._itl_ewma.value or 0.0) * 1e3,
+            # prefill-path visibility: the chunk budget in force, how many
+            # slots are mid-prefill, and the compiled one-shot bucket count
+            # (the compile-storm gauge the bucket cap bounds)
+            "prefill_chunk": self.prefill_chunk,
+            "prefilling": len(self._prefilling),
+            "prefill_buckets": len(self._buckets_seen),
         }
+        if self._prefix_cache is not None:
+            snap.update(self._prefix_cache.stats())
+        else:
+            snap.update({
+                "prefix_hits": 0, "prefix_misses": 0, "prefix_stores": 0,
+                "prefix_evictions": 0, "prefix_entries": 0,
+                "prefix_hit_rate": 0.0,
+            })
         with self._lock:  # step() extends these under the same lock
             ttft, itl = list(self._ttft), list(self._itl)
-        for name, samples in (("ttft_ms", ttft), ("itl_ms", itl)):
+            itl_decode = list(self._itl_decode)
+        for name, samples in (
+            ("ttft_ms", ttft), ("itl_ms", itl), ("itl_decode_ms", itl_decode),
+        ):
             for pct, val in _percentiles(samples).items():
                 snap[f"{name}_{pct}"] = val * 1e3
         for k in (
@@ -1034,6 +1539,8 @@ class ServingEngine:
             "peak_occupancy", "peak_queue_depth",
             "tick_faults", "poisoned_slots", "breaker_trips", "shed_infeasible",
             "rejected_draining", "drain_forced", "reloads", "reloads_rejected",
+            "prefill_chunks", "prefill_faults", "prefill_bucket_capped",
+            "expired_prefilling",
         ):
             snap[k] = self.stats[k]
         return snap

@@ -1,0 +1,123 @@
+"""Chunk-aligned token-prefix K/V cache (vLLM-style block hashing).
+
+Shared-prefix traffic — N personas behind one system prompt, retried
+requests, agent loops replaying a conversation head — re-pays prefill for
+token spans whose K/V the engine has already computed. This LRU lets a new
+prompt skip straight to its first novel chunk:
+
+- **Key scheme**: an entry covers ONE chunk of ``chunk_tokens`` tokens and
+  is keyed by the ENTIRE token prefix up to and including that chunk
+  (``tuple(prompt[:j * chunk])``), not by the chunk's own tokens — K/V at a
+  position depends on every earlier token, so two prompts may share chunk
+  *contents* but never chunk *K/V* unless the whole prefix matches. This is
+  exactly vLLM's prefix/block hash. Exact tuple keys (not a digest) mean a
+  hash collision can never serve wrong K/V.
+- **Value**: the per-layer K/V span for that chunk's positions
+  (``SlotKVCache.extract_span`` — int8 scale leaves included), copied OUT
+  of a slot row when a prefill completes and back IN on a later hit.
+  Deterministic forward ⇒ reused spans are bit-identical to recomputation,
+  so prefix hits preserve the engine's byte-identical parity contract.
+- **Hit walk**: ``lookup`` extends the match one chunk at a time and stops
+  strictly BEFORE the prompt's final token (``j * chunk < len(prompt)``):
+  the last chunk is always recomputed, because the admission needs the
+  logits at ``true_len - 1`` and spans store K/V only.
+- **Invalidation**: ``flush()`` on hot weight reload (new weights make
+  every cached span stale) and on device-state rebuild after a tick fault
+  (the buffers are suspect). The engine owns calling it.
+
+Host-side bookkeeping only; the device copies happen in the engine's jitted
+span ops. Not thread-safe by itself — only the scheduler tick thread touches
+it (admission and completion both run inside ``step()``).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Sequence, Tuple
+
+
+class PrefixCache:
+    """LRU of chunk-aligned prefix K/V spans.
+
+    ``capacity`` counts CHUNK ENTRIES (each worth ``chunk_tokens`` cache
+    positions of K/V per layer), so the device memory the cache pins is
+    bounded at ``capacity * chunk_tokens`` positions regardless of how many
+    distinct prompts pass through.
+    """
+
+    def __init__(self, chunk_tokens: int, capacity: int):
+        if chunk_tokens < 1:
+            raise ValueError("chunk_tokens must be >= 1")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1 (0 disables at the engine)")
+        self.chunk_tokens = chunk_tokens
+        self.capacity = capacity
+        self._entries: "OrderedDict[Tuple[int, ...], Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _key(self, prompt: Sequence[int], j: int) -> Tuple[int, ...]:
+        return tuple(prompt[: j * self.chunk_tokens])
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[int, List[Any]]:
+        """Longest chunk-aligned cached prefix of ``prompt``.
+
+        Returns ``(tokens_covered, spans)`` where ``spans[i]`` is chunk
+        ``i+1``'s K/V span; every covered chunk counts a hit and every
+        remaining chunk-aligned chunk (still ending before the final token)
+        counts a miss. The walk stops at the first absent chunk — a cached
+        DEEPER chunk is unusable without its predecessors' K/V in the row.
+        """
+        C = self.chunk_tokens
+        spans: List[Any] = []
+        j = 1
+        while j * C < len(prompt):
+            span = self._entries.get(self._key(prompt, j))
+            if span is None:
+                break
+            self._entries.move_to_end(self._key(prompt, j))
+            spans.append(span)
+            self.hits += 1
+            j += 1
+        while j * C < len(prompt):
+            self.misses += 1
+            j += 1
+        return len(spans) * C, spans
+
+    def contains(self, prompt: Sequence[int], j: int) -> bool:
+        return self._key(prompt, j) in self._entries
+
+    def store(self, prompt: Sequence[int], j: int, span: Any) -> None:
+        """Insert chunk ``j`` (1-based) of ``prompt``'s prefix; evicts LRU
+        entries past capacity. Re-storing an existing key just refreshes
+        its recency (the spans are bit-identical by construction)."""
+        key = self._key(prompt, j)
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        self._entries[key] = span
+        self.stores += 1
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def flush(self) -> int:
+        """Drop every entry (hot reload / device rebuild); returns how many."""
+        n = len(self._entries)
+        self._entries.clear()
+        return n
+
+    def stats(self) -> Dict[str, float]:
+        total = self.hits + self.misses
+        return {
+            "prefix_hits": self.hits,
+            "prefix_misses": self.misses,
+            "prefix_stores": self.stores,
+            "prefix_evictions": self.evictions,
+            "prefix_entries": len(self._entries),
+            "prefix_hit_rate": (self.hits / total) if total else 0.0,
+        }

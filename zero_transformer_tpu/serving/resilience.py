@@ -248,11 +248,14 @@ def validate_reload(current: Any, candidate: Any) -> None:
 class ServeFault(Fault):
     """A serving fault (extends the training ``Fault``).
 
-    kind: "tick_fault" | "nan_logits" | "slow_tick" | "sigterm" |
-          "corrupt_reload"
+    kind: "tick_fault" | "prefill_fault" | "nan_logits" | "slow_tick" |
+          "sigterm" | "corrupt_reload"
     step: the scheduler TICK index the fault keys on (engine ``_tick``,
       0-based) — sigterm/slow_tick fire once at the first tick >= step;
-      tick_fault / nan_logits fire for ``duration`` consecutive ticks.
+      tick_fault / prefill_fault / nan_logits fire for ``duration``
+      consecutive ticks. A prefill_fault raises inside the CHUNK-prefill
+      dispatch (before the fused decode), proving the engine fails only
+      the mid-prefill slots and leaves decoding neighbors untouched.
     slots: for "nan_logits", which cache rows to poison (None = every
       occupied row) — how the harness proves the guard retires ONLY the
       affected slots.
@@ -290,6 +293,16 @@ class ServingChaosMonkey(ChaosMonkey):
                 if not f.fired:
                     self.record(f)
                 raise f.exc(f"{f.message} (decode tick {tick})")
+
+    def on_prefill_chunk(self, tick: int) -> None:
+        """Called at the top of a supervised chunk-prefill dispatch: a
+        "prefill_fault" in its window raises here, through the exact path
+        a real mid-chunk blow-up (OOM, bad artifact math) takes."""
+        for f in self._of_kind("prefill_fault"):
+            if f.step <= tick < f.step + int(f.duration):
+                if not f.fired:
+                    self.record(f)
+                raise f.exc(f"{f.message} (prefill chunk, tick {tick})")
 
     def poison_logits(self, tick: int, logits):
         import jax.numpy as jnp

@@ -15,8 +15,10 @@ CUDA+gradio app (reference ``app.py``). Endpoints:
   only when READY; 503 while starting, degraded (breaker open), draining,
   or stopped, so a load balancer routes around a sick replica. Body:
   ``{"state", "uptime_s", "reloads", "breaker_open", ...}``.
-- ``GET /metrics``: the full serving-metrics snapshot (TTFT/ITL percentiles,
-  tokens/s, rejects, resilience counters) as JSON.
+- ``GET /metrics``: the full serving-metrics snapshot (TTFT/ITL percentiles
+  — with a pure-decode ``itl_decode_ms_*`` split isolating chunked-prefill
+  interference — tokens/s, rejects, prefix-cache hit/miss/entry counters,
+  compiled prefill-bucket gauge, resilience counters) as JSON.
 - ``POST /admin/reload``: hot weight reload — load a standby msgpack tree
   off the tick thread, validate, swap between ticks without dropping a
   slot (also wired to SIGHUP by ``install_signal_handlers``).
@@ -211,6 +213,7 @@ class ServingServer:
             "breaker_open": self.engine._breaker.open,
             "slots": self.engine.n_slots,
             "active": self.engine.active_count,
+            "prefilling": len(self.engine._prefilling),
             "queued": self.engine.queue_depth,
         }
 

@@ -18,7 +18,8 @@ scalars — so admissions, retirements, and occupancy changes never recompile.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import functools
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +60,73 @@ def vectorize_index(cache: Any, n_slots: int) -> Any:
     return jax.tree_util.tree_map_with_path(widen, cache)
 
 
+# ---- token-span ops (chunked prefill + prefix cache) -----------------------
+#
+# Every K/V leaf (and int8 scale leaf) is laid out [..., n_slots, cache_len,
+# ...]: the sequence axis sits immediately after the slot axis in every
+# layout this repo produces (per-layer [B, L, KVH, D], scanned
+# [n_layers, B, L, KVH, D], scales [..., KVH, 1]) — asserted at SlotKVCache
+# construction so a future layout change fails loudly instead of silently
+# copying the wrong axis. ``axes_items`` (the per-leaf slot-axis map as a
+# sorted tuple) is a STATIC argument: one compiled program per cache
+# structure, shared across engines, with slot/start as dynamic scalars.
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _extract_spans_impl(axes_items, length, count, cache, slot):
+    """Copy ``count`` consecutive ``length``-position spans of one slot's
+    K/V rows out of the cache in ONE dispatch: a list of
+    {leaf path -> [..., 1, length, ...]} trees, span ``j`` covering
+    positions ``[j*length, (j+1)*length)``. Batching the spans matters:
+    per-span dispatches put the prefix-cache STORE cost (paid by every
+    cold shared-prefix request at completion) on the tick thread's
+    critical path once per chunk instead of once per request."""
+    axes = dict(axes_items)
+    spans: list = [{} for _ in range(count)]
+
+    def grab(path, leaf):
+        key = jax.tree_util.keystr(path)
+        ax = axes.get(key)
+        if ax is None or _leaf_name(path) in INDEX_LEAVES:
+            return
+        for j in range(count):
+            starts = [0] * leaf.ndim
+            starts[ax], starts[ax + 1] = slot, j * length
+            sizes = list(leaf.shape)
+            sizes[ax], sizes[ax + 1] = 1, length
+            spans[j][key] = jax.lax.dynamic_slice(
+                leaf, tuple(starts), tuple(sizes)
+            )
+
+    jax.tree_util.tree_map_with_path(grab, cache)
+    return spans
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _write_spans_impl(axes_items, cache, spans, slot):
+    """Write extracted spans back into one slot's rows, span ``j`` at its
+    chunk-aligned position, all in ONE dispatch (the prefix-cache HIT
+    path). Index leaves are untouched — the prefill scheduler owns the
+    fill cursor; a span copy only moves K/V bytes."""
+    axes = dict(axes_items)
+
+    def put(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if not spans or key not in spans[0]:
+            return leaf
+        ax = axes[key]
+        length = spans[0][key].shape[ax + 1]
+        for j, span in enumerate(spans):
+            starts = [0] * leaf.ndim
+            starts[ax], starts[ax + 1] = slot, j * length
+            leaf = jax.lax.dynamic_update_slice(
+                leaf, span[key].astype(leaf.dtype), tuple(starts)
+            )
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(put, cache)
+
+
 @jax.jit
 def _reset_index(cache: Any, keep: jax.Array) -> Any:
     """Zero the positions of retired slots (``keep`` [n_slots] bool). K/V
@@ -93,6 +161,68 @@ class SlotKVCache:
         self._free: List[int] = list(range(n_slots))
         self._axes = self._find_batch_axes(model)
         self._insert = self._build_insert()
+        # span ops assume [slot, seq] adjacency on every per-position leaf
+        # (see _extract_span_impl); verify against the real cache once here
+        cap = model.cache_len or model.cfg.max_seq_len
+        self.seq_capacity = cap
+        for path, leaf in jax.tree_util.tree_leaves_with_path(self.cache):
+            ax = self._axes.get(jax.tree_util.keystr(path))
+            if ax is not None and (
+                leaf.shape[ax] != n_slots or leaf.shape[ax + 1] != cap
+            ):
+                raise AssertionError(
+                    f"cache leaf {jax.tree_util.keystr(path)} breaks the "
+                    f"[slots, cache_len] adjacency span ops rely on: shape "
+                    f"{leaf.shape}, slot axis {ax}"
+                )
+
+    @property
+    def axes_items(self) -> Tuple:
+        """Per-leaf slot-axis map as a hashable (static-arg) tuple."""
+        return tuple(sorted(self._axes.items()))
+
+    # ---- token-span ops --------------------------------------------------
+
+    def _quantized_count(self, length: int, count: int) -> int:
+        """Span counts are STATIC in the compiled span ops, so every
+        distinct count is a whole compiled program traversing the cache
+        tree — an unbounded family under diverse prompt lengths (the same
+        storm the engine's prefill-bucket cap exists for). Quantize to the
+        next power of two (capped at capacity), bounding the family at
+        ~log2(capacity / chunk) programs per direction."""
+        cap = max(1, self.seq_capacity // length)
+        b = 1
+        while b < count:
+            b *= 2
+        return min(b, cap)
+
+    def extract_spans(self, slot: int, length: int, count: int) -> List[Any]:
+        """Copy the first ``count`` consecutive ``length``-position spans of
+        ``slot`` in one dispatch (prefix-cache store). Extraction is padded
+        to the quantized count; the extra spans are sliced off host-side."""
+        padded = self._quantized_count(length, count)
+        spans = _extract_spans_impl(
+            self.axes_items, length, padded, self.cache, jnp.int32(slot)
+        )
+        return spans[:count]
+
+    def write_spans(self, spans: List[Any], slot: int) -> None:
+        """Write extracted spans into ``slot`` at their chunk-aligned
+        positions, one dispatch (prefix-cache hit). The fill cursor stays
+        with the caller. Padding spans (the quantized tail, repeats of the
+        first span) land at positions >= the caller's fill cursor: the
+        validity mask hides everything at or past the cursor, and the
+        chunk prefill / decode writes overwrite those positions with real
+        K/V before the cursor ever reaches them."""
+        if not spans:
+            return
+        key, leaf = next(iter(spans[0].items()))
+        length = leaf.shape[self._axes[key] + 1]
+        padded = self._quantized_count(length, len(spans))
+        full = list(spans) + [spans[0]] * (padded - len(spans))
+        self.cache = _write_spans_impl(
+            self.axes_items, self.cache, full, jnp.int32(slot)
+        )
 
     @staticmethod
     def _find_batch_axes(model) -> Dict[str, int]:
