@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos serve-chaos bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full chaos elastic-chaos serve-chaos bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -24,6 +24,17 @@ test-full:
 # fast resilience cases are UN-marked and already run in the quick lane.
 chaos:
 	$(PY) -m pytest tests/test_resilience.py -q -m chaos $(PYTEST_ARGS)
+
+# Trustworthy-restore lane: the elastic + integrity chaos suite — corrupt
+# (truncated / bit-flipped) checkpoints quarantined with fallback, replica
+# desync caught by the cross-replica audit, plus the full checkpoint
+# integrity and elastic-resume test files. The multi-process elastic test
+# (save on 8 simulated devices, resume on 4, and 4 -> 8) is slow-marked and
+# runs in the full lane: tests/test_multihost.py::test_elastic_resume_across_world_sizes.
+elastic-chaos:
+	$(PY) -m pytest tests/test_resilience.py -q -m chaos \
+		-k "ckpt_corruption or replica" $(PYTEST_ARGS)
+	$(PY) -m pytest tests/test_checkpoint.py tests/test_elastic.py -q $(PYTEST_ARGS)
 
 # Serving fault-injection lane: the full chaos scenario over the HTTP
 # server (decode faults + NaN-logit windows + mid-load SIGTERM -> graceful

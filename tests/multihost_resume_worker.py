@@ -18,6 +18,19 @@ process boundaries:
   trains steps 3-4. Its losses must equal ``straight``'s exactly — the
   interruption is invisible in the trajectory.
 
+ELASTIC modes (test_multihost.py::test_elastic_resume_across_world_sizes)
+run under a VARIABLE process count — the topology that comes back after a
+preemption is whatever the scheduler has:
+
+- ``elastic_save``   — train steps 1-2 on THIS job's world, save step 2
+  (with topology metadata) through the verified-save path.
+- ``elastic_resume`` — a job with a DIFFERENT world size restores through
+  ``CheckpointManager.restore_verified`` (digest-verified, elastic-compat
+  checked), rebuilds the ZeRO plan for its own mesh, and trains steps 3-4.
+  Losses must match a same-topology uninterrupted run to reduction-order
+  ulps (the global batch stream is identical; only collective schedules
+  differ).
+
 Prints ``LOSS step=N <loss>`` lines and ``WORKER_OK`` on success.
 """
 import os
@@ -50,8 +63,12 @@ VICTIM = 3  # the process that "loses its host" in interrupted mode
 def main():
     mode = os.environ["WORKER_MODE"]
     assert maybe_initialize(), "coordinator env vars must trigger initialization"
-    assert jax.process_count() == 4, jax.process_count()
-    assert jax.device_count() == 8, jax.device_count()
+    if mode.startswith("elastic"):
+        # elastic phases run under whatever world the harness launched
+        assert jax.device_count() == 2 * jax.process_count(), jax.device_count()
+    else:
+        assert jax.process_count() == 4, jax.process_count()
+        assert jax.device_count() == 8, jax.device_count()
 
     # Warmup collective FIRST: gloo creates its context lazily at the first
     # cross-process collective, with a fixed 30s key-value rendezvous
@@ -128,16 +145,56 @@ def main():
             print(f"LOSS step={int(state.step)} {loss:.10f}", flush=True)
         return state
 
+    abstract = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        jax.eval_shape(lambda s: s, state),
+        plan.state,
+    )
+    # any restored state is donated by the train step below: force runtime-
+    # owned buffers first (jax 0.4.37 CPU: donating an orbax zero-copy host
+    # view corrupts the heap — glibc "corrupted double-linked list")
+    from zero_transformer_tpu.utils.jax_compat import ensure_donatable
+
     if mode == "resume":
-        abstract = jax.tree.map(
-            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
-            jax.eval_shape(lambda s: s, state),
-            plan.state,
-        )
         state, meta = mgr.restore(abstract)
+        state = ensure_donatable(state)
         assert int(state.step) == 2, int(state.step)
         loader.restore(meta["loader"])
         state = run_steps(iter(loader), state, 2, "resume")
+    elif mode == "elastic_save":
+        it = iter(loader)
+        state = run_steps(it, state, 2, "warm")
+        from zero_transformer_tpu.parallel.sharding import topology_summary
+
+        mgr.save(
+            2, state,
+            meta={"loader": loader.state(),
+                  "topology": topology_summary(mesh, 2),
+                  "schedule": {"batch_size": batch_size, "train_context": seq}},
+            force=True,
+        )
+        mgr.wait()
+        print("SAVED step=2", flush=True)
+    elif mode == "elastic_resume":
+        # the trustworthy-restore path, across a topology change: digest
+        # verification against the manifest, elastic-compat validation of
+        # the saved topology vs THIS job's mesh, orbax native reshard into
+        # the plan rebuilt for the new device count
+        from zero_transformer_tpu.parallel.sharding import check_elastic_compat
+
+        def check(meta):
+            notes = check_elastic_compat(
+                (meta or {}).get("topology"), mesh, 2, batch_size
+            )
+            for n in notes:
+                print(f"ELASTIC {n}", flush=True)
+
+        state, meta, report = mgr.restore_verified(abstract, check_meta=check)
+        state = ensure_donatable(state)
+        assert int(state.step) == 2, int(state.step)
+        assert report.quarantined == [], report.quarantined
+        loader.restore(meta["loader"])
+        state = run_steps(iter(loader), state, 2, "elastic_resume")
     else:  # straight / interrupted
         it = iter(loader)
         state = run_steps(it, state, 2, "warm")

@@ -124,6 +124,169 @@ def test_msgpack_export_import_roundtrip(setup, tmp_path):
     tree_allclose(state.params, loaded)
 
 
+def test_latest_step_skips_partial_dir(setup, tmp_path):
+    """Crash mid-async-save leaves a partial step dir; it must NEVER be the
+    resume target (regression: orbax's own latest_step trusts the listing)."""
+    mesh, model, tx, plan, state = setup
+    mgr = ckpt_lib.CheckpointManager(tmp_path / "ck", keep=5, save_frequency=1,
+                                     async_save=False)
+    mgr.save(1, state, force=True)
+    mgr.save(2, state, force=True)
+    mgr.wait()
+    # hand-made partials: an empty step dir, and one whose state item is
+    # missing its metadata (the commit marker never landed)
+    (tmp_path / "ck" / "4").mkdir()
+    half = tmp_path / "ck" / "8"
+    (half / "state").mkdir(parents=True)
+    (half / "meta").mkdir()
+    mgr2 = ckpt_lib.CheckpointManager(tmp_path / "ck", keep=5)
+    assert mgr2.latest_step() == 2
+    assert mgr2.all_steps() == [1, 2]
+    target = ckpt_lib.abstract_state(model, tx, plan, SHAPE)
+    restored, _, report = mgr2.restore_verified(target)
+    assert report.step == 2 and report.quarantined == []
+    tree_allclose(state, restored)
+    mgr.close()
+    mgr2.close()
+
+
+def _corrupt(step_dir, mode):
+    from zero_transformer_tpu.resilience.chaos import corrupt_step_dir
+
+    corrupt_step_dir(step_dir, f"ckpt_{mode}")
+
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+def test_corrupt_step_quarantined_with_fallback(setup, tmp_path, mode):
+    """A truncated or bit-flipped newest step is quarantined (renamed aside,
+    counted, evented) and restore falls back to the newest VERIFIED step —
+    never crash-looping on the same bad artifact."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    mesh, model, tx, plan, state = setup
+    root = tmp_path / f"ck_{mode}"
+    mgr = ckpt_lib.CheckpointManager(root, keep=5, save_frequency=1,
+                                     async_save=False)
+    good = dataclasses.replace(state, step=jnp.asarray(1, jnp.int32))
+    mgr.save(1, good, force=True)
+    mgr.save(2, dataclasses.replace(state, step=jnp.asarray(2, jnp.int32)),
+             force=True)
+    mgr.wait()
+    _corrupt(root / "2", mode)
+
+    events = []
+    target = ckpt_lib.abstract_state(model, tx, plan, SHAPE)
+    restored, _, report = mgr.restore_verified(
+        target, on_event=lambda name, step, **f: events.append((name, step))
+    )
+    assert report.step == 1 and report.quarantined == [2]
+    assert report.fallback_steps == 1
+    tree_allclose(good, restored)
+    assert ("ckpt_quarantined", 2) in events
+    assert ("restore_fallback", 1) in events
+    assert (root / "2.quarantined").exists()
+    assert mgr.latest_step() == 1  # the quarantined dir left the listing
+    mgr.close()
+
+
+def test_quarantine_tombstones_in_place_when_rename_unsupported(
+    setup, tmp_path, monkeypatch
+):
+    """Object stores can't rename directories: quarantine must fall back to
+    an in-place _QUARANTINED tombstone that takes the step out of the
+    candidate set, so a corrupt checkpoint on gs:// still falls back
+    instead of crash-looping on the seen-step guard."""
+    import dataclasses
+    import pathlib
+
+    import jax.numpy as jnp
+
+    mesh, model, tx, plan, state = setup
+    root = tmp_path / "ck_tomb"
+    mgr = ckpt_lib.CheckpointManager(root, keep=5, save_frequency=1,
+                                     async_save=False)
+    good = dataclasses.replace(state, step=jnp.asarray(1, jnp.int32))
+    mgr.save(1, good, force=True)
+    mgr.save(2, dataclasses.replace(state, step=jnp.asarray(2, jnp.int32)),
+             force=True)
+    mgr.wait()
+    _corrupt(root / "2", "truncate")
+
+    from etils import epath
+
+    # orbax's find_step_path returns an etils epath.Path whose rename does
+    # not route through pathlib — deny the directory rename on BOTH types
+    for cls in {pathlib.Path, type(epath.Path(str(root)))}:
+        real_rename = cls.rename
+
+        def deny(self, target, _real=real_rename):
+            if str(self) == str(root / "2"):
+                raise OSError("rename of directories is not supported")
+            return _real(self, target)
+
+        monkeypatch.setattr(cls, "rename", deny)
+    target = ckpt_lib.abstract_state(model, tx, plan, SHAPE)
+    restored, _, report = mgr.restore_verified(target)
+    assert report.step == 1 and report.quarantined == [2]
+    assert (root / "2" / "_QUARANTINED").exists()
+    assert mgr.latest_step() == 1  # tombstoned step left the candidate set
+    tree_allclose(good, restored)
+    mgr.close()
+
+
+def test_all_steps_corrupt_raises_actionable_error(setup, tmp_path):
+    mesh, model, tx, plan, state = setup
+    root = tmp_path / "ck_dead"
+    mgr = ckpt_lib.CheckpointManager(root, keep=5, async_save=False)
+    mgr.save(1, state, force=True)
+    mgr.wait()
+    _corrupt(root / "1", "truncate")
+    with pytest.raises(FileNotFoundError, match="no verified checkpoint"):
+        mgr.restore_verified(ckpt_lib.abstract_state(model, tx, plan, SHAPE))
+    assert (root / "1.quarantined").exists()
+    mgr.close()
+
+
+def test_manifest_structural_mismatch_is_fatal_not_quarantine(setup, tmp_path):
+    """A checkpoint from a DIFFERENT model must raise the precise config
+    error — quarantining it would discard a good checkpoint."""
+    import dataclasses as dc
+
+    mesh, model, tx, plan, state = setup
+    mgr = ckpt_lib.CheckpointManager(tmp_path / "ck", async_save=False)
+    mgr.save(1, state, force=True)
+    mgr.wait()
+    other_cfg = dc.replace(CFG, d_model=128, n_heads=8)
+    other = Transformer(other_cfg)
+    other_plan = make_plan(other, tx, mesh, SHAPE, zero_stage=1)
+    target = ckpt_lib.abstract_state(other, tx, other_plan, SHAPE)
+    with pytest.raises(ValueError, match="different model/optimizer"):
+        mgr.restore_verified(target)
+    assert mgr.latest_step() == 1  # NOT quarantined
+    mgr.close()
+
+
+def test_tree_digests_exact_and_layout_invariant(setup):
+    """The digest is an exact bit-sum: identical values -> identical digest
+    regardless of sharding; one changed element -> different digest."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh, model, tx, plan, state = setup
+    ref = np.arange(64, dtype=np.float32).reshape(8, 8)
+    sharded = jax.device_put(ref, NamedSharding(mesh, P("data")))
+    replicated = jax.device_put(ref, NamedSharding(mesh, P()))
+    d1 = ckpt_lib.tree_digests({"x": sharded})
+    d2 = ckpt_lib.tree_digests({"x": replicated})
+    assert d1 == d2
+    flipped = ref.copy()
+    flipped[3, 3] = np.float32(np.nextafter(flipped[3, 3], np.inf))
+    d3 = ckpt_lib.tree_digests({"x": jnp.asarray(flipped)})
+    assert d3 != d1
+
+
 def test_remote_gs_path_not_mangled():
     """gs:// directories must survive construction untouched (the reference's
     deployment mode, main_zero.py:58-93 writes checkpoints to GCS buckets).

@@ -51,7 +51,27 @@ def apply_overrides(cfg, overrides: dict):
 def main():
     parser = argparse.ArgumentParser(description="TPU-native ZeRO transformer trainer")
     parser.add_argument("--cfg", default="configs/train_test.yaml")
-    parser.add_argument("--resume", action="store_true", default=False)
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        default=False,
+        help="resume from the newest VERIFIED checkpoint (corrupt step dirs "
+        "are quarantined with fallback to an older verified step). Elastic: "
+        "resuming onto a DIFFERENT device/host count than the checkpoint "
+        "was saved under reshards the ZeRO state natively and preserves the "
+        "global-token trajectory; genuinely incompatible topologies fail "
+        "with a precise error before compilation",
+    )
+    parser.add_argument(
+        "--audit-frequency",
+        type=int,
+        default=None,
+        metavar="N",
+        help="cross-replica divergence audit every N steps (overrides "
+        "resilience.audit_frequency): bit-exact agreement check of the "
+        "DP-replicated state inside the compiled step — catches silent "
+        "data corruption that desyncs one replica",
+    )
     parser.add_argument(
         "--supervise",
         action="store_true",
@@ -113,6 +133,13 @@ def main():
     if args.profile:
         cfg = dataclasses.replace(
             cfg, training=dataclasses.replace(cfg.training, profile_steps=args.profile)
+        )
+    if args.audit_frequency is not None:
+        cfg = dataclasses.replace(
+            cfg,
+            resilience=dataclasses.replace(
+                cfg.resilience, audit_frequency=args.audit_frequency
+            ),
         )
 
     logging.info(

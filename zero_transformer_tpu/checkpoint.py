@@ -21,9 +21,12 @@ export shim (consumed by its ``torch_compatability/extract_msgpack.py:28-47``).
 """
 from __future__ import annotations
 
+import dataclasses
 import json
+import logging
+import time
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
 import numpy as np
@@ -33,6 +36,177 @@ from zero_transformer_tpu.parallel.zero import TrainState
 
 
 from zero_transformer_tpu.utils.paths import is_remote_path  # noqa: F401 (re-export)
+
+log = logging.getLogger("zero_transformer_tpu")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A step directory failed integrity verification (truncated files,
+    digest mismatch, unreadable metadata). Raised internally and handled by
+    ``CheckpointManager.restore_verified`` (quarantine + fallback); it only
+    escapes when NO verified step remains."""
+
+
+def _leaf_paths(tree) -> List[str]:
+    return [
+        jax.tree_util.keystr(kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+
+
+@jax.jit
+def _tree_checksums(leaves):
+    from zero_transformer_tpu.resilience.detect import leaf_checksum
+
+    return [leaf_checksum(l) for l in leaves]
+
+
+def _np_checksum(x) -> int:
+    """Host-side counterpart of ``detect.leaf_checksum`` — identical math
+    (uint32 wrap-sum of the raw bits; numpy's ``sum(dtype=uint32)`` wraps
+    exactly like XLA's), so both digest paths produce the same value.
+    64-bit elements view as uint32 PAIRS, matching the jit path's word
+    split (a 64->32 narrowing would hide high-word bit flips)."""
+    a = np.asarray(x)
+    if a.dtype == np.bool_:
+        a = a.astype(np.uint8)
+    width = min(a.dtype.itemsize, 4)
+    u = a.reshape(-1).view(f"u{width}")
+    return int(np.sum(u, dtype=np.uint32))
+
+
+def tree_digests(tree) -> dict[str, int]:
+    """Per-leaf content digests keyed by keypath: exact uint32 wrap-sums of
+    the raw bits (``resilience.detect.leaf_checksum``). The digest of a
+    logical array is independent of dtype layout, sharding, or device count
+    (wrap-add is commutative and exact) — the property that lets a manifest
+    written under one topology verify a restore onto another.
+
+    Two equivalent paths: on a single-process CPU backend the leaves are
+    digested from zero-copy host views on a small thread pool (numpy sum
+    runs at memory bandwidth and releases the GIL; XLA's CPU "devices"
+    share the same cores, so the on-device path is no faster there).
+    Everywhere else — accelerators, multihost — ONE jit call digests on
+    device; sharded leaves reduce via the collectives GSPMD inserts, so on
+    a pod every host gets the same replicated scalars."""
+    paths = _leaf_paths(tree)
+    leaves = jax.tree.leaves(tree)
+    host_path = (
+        jax.process_count() == 1
+        and jax.default_backend() == "cpu"
+        and all(
+            getattr(leaf, "is_fully_addressable", True) for leaf in leaves
+        )
+    )
+    if host_path:
+        import os
+        from concurrent.futures import ThreadPoolExecutor
+
+        jax.block_until_ready(leaves)
+        workers = max(2, min(4, os.cpu_count() or 2))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            vals = list(pool.map(_np_checksum, leaves))
+    else:
+        vals = jax.device_get(_tree_checksums(tuple(leaves)))
+    return {p: int(v) for p, v in zip(paths, vals)}
+
+
+def build_manifest(state) -> dict:
+    """Integrity manifest for one step: per-leaf digest + shape + dtype.
+
+    Shape/dtype make structural mismatch (a checkpoint from a DIFFERENT
+    model/optimizer) distinguishable from corruption — the former is a
+    fatal config error, the latter is quarantined."""
+    digests = tree_digests(state)
+    leaves = {
+        p: {
+            "sum": digests[p],
+            "shape": list(leaf.shape),
+            "dtype": str(jax.numpy.dtype(leaf.dtype)),
+        }
+        for p, leaf in zip(
+            _leaf_paths(state), jax.tree.leaves(state)
+        )
+    }
+    return {"version": 1, "algo": "u32sum", "leaves": leaves}
+
+
+def manifest_mismatch(manifest: dict, target) -> Optional[str]:
+    """Structural diff between a saved manifest and a restore target's
+    abstract tree — None when they describe the same model/optimizer."""
+    saved = manifest.get("leaves", {})
+    tgt = {
+        p: leaf
+        for p, leaf in zip(_leaf_paths(target), jax.tree.leaves(target))
+    }
+    missing = sorted(set(saved) - set(tgt))
+    unexpected = sorted(set(tgt) - set(saved))
+    if missing or unexpected:
+        return (
+            f"leaf sets differ (checkpoint-only: {missing[:3]}, "
+            f"target-only: {unexpected[:3]})"
+        )
+    for p, info in saved.items():
+        if tuple(info["shape"]) != tuple(tgt[p].shape):
+            return (
+                f"{p} shaped {tuple(info['shape'])} in the checkpoint but "
+                f"{tuple(tgt[p].shape)} in the model"
+            )
+        if str(info["dtype"]) != str(jax.numpy.dtype(tgt[p].dtype)):
+            return (
+                f"{p} is {info['dtype']} in the checkpoint but "
+                f"{jax.numpy.dtype(tgt[p].dtype)} in the model"
+            )
+    return None
+
+
+# clearly-transient storage/network fingerprints: a restore failure that
+# matches these is RE-RAISED (the supervisor retries with the step dir
+# intact) instead of quarantining a healthy checkpoint over a network blip.
+# Deliberately narrower than supervisor._RETRYABLE_PATTERNS: "data_loss"
+# style codes ARE corruption and must quarantine.
+_TRANSIENT_PATTERNS = (
+    "unavailable",
+    "deadline_exceeded",
+    "timed out",
+    "timeout",
+    "connection",
+    "socket",
+    "broken pipe",
+    "reset by peer",
+    "aborted",
+    "eof occurred",
+    "temporarily",
+    "transient",
+    "too many requests",
+    "service unavailable",
+    "resource_exhausted",
+    "memoryerror",
+    "unable to allocate",
+    "out of memory",
+)
+
+
+def _looks_transient(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return any(pat in msg for pat in _TRANSIENT_PATTERNS)
+
+
+@dataclasses.dataclass
+class RestoreReport:
+    """What ``restore_verified`` had to do to produce a trustworthy state."""
+
+    step: Optional[int] = None  # the step that finally verified
+    requested_step: Optional[int] = None  # newest candidate at entry
+    quarantined: List[int] = dataclasses.field(default_factory=list)
+    verify_ms: float = 0.0  # digest re-computation time at restore
+
+    @property
+    def fallback_steps(self) -> int:
+        """How far behind the newest candidate the verified restore landed."""
+        if self.step is None or self.requested_step is None:
+            return 0
+        return int(self.requested_step - self.step)
 
 
 def resolve_ckpt_path(directory: str | Path):
@@ -81,11 +255,19 @@ class CheckpointManager:
         keep: int = 5,
         save_frequency: int = 1000,
         async_save: bool = True,
+        integrity: bool = True,
     ):
         self.directory = resolve_ckpt_path(directory)
         self.save_frequency = save_frequency
         self._keep = keep
         self._async_save = async_save
+        # integrity manifests: every save also writes a per-leaf content-
+        # digest item; restore_verified() re-digests the restored leaves
+        # against it and quarantines mismatching step dirs
+        self.integrity = integrity
+        # digest time of the most recent save tick (the <5% budget is
+        # measured against this; surfaced as train/ckpt_verify_ms)
+        self.last_digest_ms: float = 0.0
         # The orbax manager is built LAZILY: its constructor touches storage
         # (creates the root directory), which for a gs:// path would need
         # bucket access just to instantiate. Path resolution/formatting must
@@ -152,14 +334,34 @@ class CheckpointManager:
         self.check_for_errors()
         if not force and (step == 0 or step % self.save_frequency != 0):
             return False
-        return self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(meta or {}),
-            ),
-            force=force,
-        )
+        # a PARTIAL dir for this step (crash mid-save on an object store —
+        # no atomic rename) would make orbax's save raise
+        # StepAlreadyExistsError, crash-looping a resumed run every time it
+        # re-reaches this step; move the garbage aside first
+        try:
+            in_the_way = self.step_path(step).exists() and not self._step_complete(step)
+        except OSError:
+            in_the_way = False
+        if in_the_way:
+            self.quarantine(
+                step, "incomplete step dir (crash mid-save) in the way of a new save"
+            )
+        items = {
+            "state": ocp.args.StandardSave(state),
+            "meta": ocp.args.JsonSave(meta or {}),
+        }
+        if self.integrity:
+            # digest from the live device state BEFORE orbax serializes it:
+            # one bandwidth-bound read (collective-reduced on pods, so every
+            # host sees the same replicated scalars and process 0's JSON
+            # write covers the whole tree). Restore re-digests and compares
+            # — any storage-introduced change, torn write, or bit flip
+            # between here and the future restore fails verification.
+            t0 = time.perf_counter()
+            manifest = build_manifest(state)
+            self.last_digest_ms = (time.perf_counter() - t0) * 1e3
+            items["manifest"] = ocp.args.JsonSave(manifest)
+        return self._mgr.save(step, args=ocp.args.Composite(**items), force=force)
 
     def restore(
         self, target: TrainState, step: Optional[int] = None
@@ -177,6 +379,211 @@ class CheckpointManager:
             ),
         )
         return out["state"], out["meta"]
+
+    # -- trustworthy restore -------------------------------------------------
+
+    def _reset_mgr(self) -> None:
+        """Drop the lazy orbax manager so the next access re-reads storage
+        (it caches step metadata; a quarantine rename invalidates that)."""
+        if self._mgr_inst is None:
+            return
+        try:
+            self._mgr_inst.close()
+        except Exception:
+            log.exception("checkpoint: manager close during reset (ignored)")
+        self._mgr_inst = None
+
+    def quarantine(self, step: int, reason: str) -> Optional[str]:
+        """Take ``step`` out of the restore-candidate set, preserved for
+        post-mortem: rename the dir to ``<step>.quarantined`` where the
+        storage supports it, else (object stores — gs:// prefixes cannot be
+        renamed) drop a ``_QUARANTINED`` tombstone file inside it, which
+        ``_step_complete`` treats as incomplete. Returns the quarantined
+        path (None when the dir vanished — another pod process got there
+        first; the rename/tombstone is the commit point, first-wins)."""
+        try:
+            path = ocp.step.find_step_path(
+                self.directory, ocp.step.standard_name_format(), step=step
+            )
+        except (ValueError, FileNotFoundError):
+            path = self.step_path(step)
+        dest = path.parent / f"{path.name}.quarantined"
+        n = 0
+        while dest.exists():
+            n += 1
+            dest = path.parent / f"{path.name}.quarantined.{n}"
+        try:
+            path.rename(dest)
+        except (FileNotFoundError, NotADirectoryError) as e:
+            # the dir vanished: another pod process quarantined it first
+            log.warning(
+                "checkpoint: step %d already quarantined elsewhere (%s)", step, e
+            )
+            self._reset_mgr()
+            return None
+        except OSError as rename_err:
+            # object stores (and read-only mounts) reject directory renames;
+            # fall back to an in-place tombstone that _step_complete honors
+            try:
+                (path / "_QUARANTINED").write_text(str(reason)[:500])
+            except OSError as e:
+                # even the tombstone failed — the caller's seen-step guard
+                # turns this into a hard error instead of re-restoring the
+                # same corrupt step forever
+                log.error(
+                    "checkpoint: could not quarantine step %d (rename: %s; "
+                    "tombstone: %s) — the corrupt dir remains a restore "
+                    "candidate", step, rename_err, e,
+                )
+                self._reset_mgr()
+                return None
+            log.error(
+                "checkpoint: step %d QUARANTINED in place via tombstone "
+                "(%s; dir rename unsupported: %s)", step, reason, rename_err,
+            )
+            self._reset_mgr()
+            return str(path)
+        log.error(
+            "checkpoint: step %d QUARANTINED -> %s (%s)", step, dest, reason
+        )
+        self._reset_mgr()
+        return str(dest)
+
+    def restore_verified(
+        self,
+        target: TrainState,
+        check_meta: Optional[Callable[[dict], None]] = None,
+        on_event: Optional[Callable] = None,
+    ) -> tuple[TrainState, dict, RestoreReport]:
+        """Restore the newest step that passes integrity verification.
+
+        Per candidate (newest first): read ``meta`` + ``manifest`` (cheap
+        JSON); reject a manifest that describes a DIFFERENT model/optimizer
+        with a precise ``ValueError`` (that is a config error, not
+        corruption — quarantining it would discard a good checkpoint); run
+        ``check_meta`` (the trainer's elastic-topology validation — raises
+        before any array IO or compilation); restore the state; re-digest the
+        restored leaves against the manifest. Any read failure or digest
+        mismatch QUARANTINES the step dir and falls back to the next older
+        candidate — so a supervised restart never crash-loops on the same
+        bad artifact. Raises ``FileNotFoundError`` when no verified step
+        remains (fatal to the supervisor: retrying cannot mint a good
+        checkpoint).
+
+        ``on_event(name, step, **fields)`` mirrors ``MetricsLogger.event``.
+        Returns ``(state, meta, RestoreReport)``.
+        """
+        report = RestoreReport()
+        report.requested_step = self.latest_step()
+        seen: set = set()
+        while True:
+            step = self.latest_step()
+            if step is not None and step in seen:
+                # quarantine failed to remove the dir (read-only storage?):
+                # without this guard the loop would re-restore and re-fail
+                # the same corrupt step forever
+                raise RuntimeError(
+                    f"checkpoint step {step} under {self.directory} failed "
+                    f"verification but could not be quarantined (rename "
+                    f"failed — read-only storage or missing permissions?); "
+                    f"move the step dir aside manually and rerun"
+                )
+            if step is None:
+                raise FileNotFoundError(
+                    f"no verified checkpoint under {self.directory} "
+                    f"({len(report.quarantined)} step(s) quarantined this "
+                    f"restore: {report.quarantined}; inspect the "
+                    f"*.quarantined dirs or point --resume elsewhere)"
+                )
+
+            seen.add(step)
+
+            def _bad(reason: str) -> None:
+                dest = self.quarantine(step, reason)
+                report.quarantined.append(step)
+                if on_event is not None:
+                    on_event(
+                        "ckpt_quarantined", step,
+                        reason=str(reason)[:200], path=dest or "",
+                    )
+
+            step_dir = self.step_path(step)
+            manifest = None
+            try:
+                items = {"meta": ocp.args.JsonRestore()}
+                if (step_dir / "manifest").exists():
+                    items["manifest"] = ocp.args.JsonRestore()
+                pre = self._mgr.restore(step, args=ocp.args.Composite(**items))
+                meta = pre["meta"] or {}
+                manifest = pre["manifest"] if "manifest" in items else None
+            except Exception as e:
+                if _looks_transient(e):
+                    raise  # network blip, not corruption: retry, dir intact
+                _bad(f"unreadable step metadata: {type(e).__name__}: {e}")
+                continue
+            if manifest is not None:
+                mismatch = manifest_mismatch(manifest, target)
+                if mismatch is not None:
+                    raise ValueError(
+                        f"checkpoint step {step} under {self.directory} was "
+                        f"saved for a different model/optimizer: {mismatch}. "
+                        f"This is a config mismatch, not corruption — fix the "
+                        f"config (or warm-init instead of resuming)"
+                    )
+            if check_meta is not None:
+                check_meta(meta)  # ValueError here is fatal by design
+            try:
+                out = self._mgr.restore(
+                    step, args=ocp.args.Composite(state=ocp.args.StandardRestore(target))
+                )
+                state = out["state"]
+            except Exception as e:
+                if _looks_transient(e):
+                    raise  # network blip, not corruption: retry, dir intact
+                if manifest is None:
+                    # pre-manifest checkpoint: without the structural check
+                    # above, a restore failure may be a CONFIG mismatch
+                    # (wrong model), not corruption — quarantining would
+                    # mangle a healthy directory. Preserve the old restore()
+                    # behavior: raise with the dir intact.
+                    raise
+                _bad(f"state restore failed: {type(e).__name__}: {e}")
+                continue
+            if manifest is not None and self.integrity:
+                t0 = time.perf_counter()
+                fresh = tree_digests(state)
+                report.verify_ms += (time.perf_counter() - t0) * 1e3
+                bad_leaves = [
+                    p for p, info in manifest["leaves"].items()
+                    if int(info["sum"]) != fresh.get(p)
+                ]
+                if bad_leaves:
+                    _bad(
+                        f"digest mismatch on {len(bad_leaves)} leaf/leaves "
+                        f"(e.g. {bad_leaves[:3]}) — silent data corruption"
+                    )
+                    continue
+            elif manifest is None:
+                log.warning(
+                    "checkpoint: step %d predates integrity manifests — "
+                    "restored UNVERIFIED", step,
+                )
+            report.step = step
+            if report.fallback_steps:
+                log.warning(
+                    "checkpoint: restore fell back %d step(s) (step %s -> %s) "
+                    "past %d quarantined dir(s)",
+                    report.fallback_steps, report.requested_step, step,
+                    len(report.quarantined),
+                )
+                if on_event is not None:
+                    on_event(
+                        "restore_fallback", step,
+                        from_step=report.requested_step,
+                        fallback_steps=report.fallback_steps,
+                        quarantined=len(report.quarantined),
+                    )
+            return state, meta, report
 
     def restore_params(self, abstract_params: Any, step: Optional[int] = None) -> Any:
         """Params-only restore — the ``warm_init`` path for scale-up surgery
@@ -223,11 +630,54 @@ class CheckpointManager:
             ckptr.close()
         return out["params"]
 
+    def _step_complete(self, step: int) -> bool:
+        """True when ``step``'s directory is a COMMITTED checkpoint.
+
+        A crash mid-async-save can leave a partial step directory (on object
+        stores there is no atomic rename; locally, a hand-interrupted copy or
+        a half-written restore from backup looks the same). Orbax's own
+        ``latest_step`` trusts the directory listing — which made the newest
+        *partial* dir the resume target. Completeness here means: orbax
+        finalized it (tmp-name / commit-marker check), the manager-level
+        ``_CHECKPOINT_METADATA`` (written at commit) exists, and the
+        ``state`` item directory exists with its metadata file."""
+        d = self.step_path(step)
+        try:
+            if not ocp.step.is_checkpoint_finalized(d):
+                return False
+        except (OSError, ValueError):
+            return False
+        if (d / "_QUARANTINED").exists():
+            # tombstone-quarantined in place (object stores can't rename
+            # directories): never a restore candidate again
+            return False
+        state_dir = d / "state"
+        return (
+            (d / "_CHECKPOINT_METADATA").exists()
+            and state_dir.exists()
+            and (state_dir / "_METADATA").exists()
+        )
+
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        """Newest COMPLETE step (partial/uncommitted dirs are skipped — they
+        exist after a crash mid-async-save and must never be the resume
+        target)."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            if self._step_complete(step):
+                return step
+        return None
 
     def all_steps(self):
-        return sorted(self._mgr.all_steps())
+        return sorted(s for s in self._mgr.all_steps() if self._step_complete(s))
+
+    def incomplete_steps(self) -> list:
+        """Step dirs present in the listing that fail the completeness check
+        (crash mid-save leftovers — or, pathologically, checkpoints whose
+        commit markers a backup tool dropped). Lets a resume distinguish
+        'nothing to resume' from 'steps exist but none are trustworthy'."""
+        return sorted(
+            s for s in self._mgr.all_steps() if not self._step_complete(s)
+        )
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -360,6 +360,17 @@ class ResilienceConfig:
     rollback_after: int = 3
     snapshot_frequency: int = 200  # steps between host-RAM state mirrors
     max_rollbacks: int = 3  # budget per train() call; exceeding it halts
+    # cross-replica divergence audit: every N steps the anomaly guard
+    # checksums the state leaves that are REPLICATED over the ZeRO axes on
+    # every DP replica (in-graph shard_map + scalar all_gather — no host
+    # sync) and flags any bit-level disagreement. Catches silent data
+    # corruption that desynced one replica within N steps instead of never
+    # (XLA assumes replicated copies identical; a desync otherwise only
+    # shows up when the loss curves fork). 0 disables. Escalation on a trip:
+    # anomaly_response 'rollback' re-places the host snapshot (which
+    # re-replicates identical copies — the desync is HEALED); anything else
+    # halts (a desynced replica cannot be skipped past).
+    audit_frequency: int = 0
     # hang watchdog: abort (retryably) when no step completes for this many
     # seconds; 0 disables. Must comfortably exceed worst-case compile +
     # checkpoint-write time.
@@ -388,6 +399,14 @@ class ResilienceConfig:
                 raise ValueError(f"{name} must be >= 1")
         if self.snapshot_frequency < 0 or self.max_rollbacks < 0:
             raise ValueError("snapshot_frequency/max_rollbacks must be >= 0")
+        if self.audit_frequency < 0:
+            raise ValueError("audit_frequency must be >= 0 (0 disables)")
+        if self.audit_frequency > 0 and not self.anomaly_detection:
+            raise ValueError(
+                "audit_frequency requires anomaly_detection: the replica "
+                "audit rides the in-graph anomaly-guard carry (it would be "
+                "silently inert with the guard disabled)"
+            )
         if self.watchdog_timeout_s < 0 or self.max_restarts < 0:
             raise ValueError("watchdog_timeout_s/max_restarts must be >= 0")
         if self.backoff_base_s <= 0 or self.backoff_max_s < self.backoff_base_s:
@@ -458,6 +477,15 @@ class CheckpointConfig:
     save_frequency: int = 1000
     async_save: bool = True
     resume: bool = False
+    # integrity manifests: every save writes a per-leaf content-digest item
+    # (exact uint32 bit-sums, computed on device in one jit call — the
+    # save-tick overhead is measured and reported as train/ckpt_verify_ms);
+    # restore re-digests the restored leaves and QUARANTINES a
+    # corrupt/truncated/mismatched step dir (renamed to *.quarantined),
+    # falling back to the newest verified older step instead of crash-
+    # looping on the same bad artifact. False = trust storage blindly
+    # (the pre-manifest behavior).
+    integrity: bool = True
     warm_init: bool = False
     warm_init_dir: str = ""
     # warm start from an exported params msgpack instead of a checkpoint dir;

@@ -165,6 +165,73 @@ def opt_state_sharding(
     )
 
 
+def topology_summary(mesh: Mesh, zero_stage: int) -> dict:
+    """JSON-serializable description of the topology a checkpoint was saved
+    under — written into every step's ``meta`` so elastic resume can compare
+    the saved world against the one it is restoring onto (and refuse, or
+    log the reshard, BEFORE any array IO or compilation)."""
+    import jax
+
+    return {
+        "mesh": {a: int(s) for a, s in mesh.shape.items()},
+        "devices": int(mesh.devices.size),
+        "processes": int(jax.process_count()),
+        "zero_stage": int(zero_stage),
+    }
+
+
+def check_elastic_compat(
+    saved: Optional[dict],
+    mesh: Mesh,
+    zero_stage: int,
+    global_batch: int,
+) -> list[str]:
+    """Validate resuming onto ``mesh`` from a checkpoint saved under
+    ``saved`` (a ``topology_summary``; None for pre-manifest checkpoints).
+
+    Raises ``ValueError`` — fatal to the supervisor, a restart cannot fix a
+    config — with a precise, actionable message for topologies that are
+    GENUINELY incompatible (the failure would otherwise surface deep inside
+    pjit as an unrelated sharding error). Everything else is elastic:
+    orbax restores sharded-native into the NEW mesh's shardings, and
+    ``make_plan`` already rebuilt the ZeRO partition spec for the new device
+    count. Returns human-readable notes describing what changed (logged by
+    the trainer so a resized resume is visible in the run log)."""
+    dp = math.prod(
+        mesh.shape.get(a, 1) for a in zero_axes(mesh)
+    )
+    if global_batch % dp:
+        raise ValueError(
+            f"elastic resume: global batch_size {global_batch} is not "
+            f"divisible by the new data-parallel world of {dp} "
+            f"(mesh {dict(mesh.shape)}). Resuming onto this topology would "
+            f"fail inside pjit at the first step — pick a mesh whose "
+            f"data*fsdp divides the batch, or adjust training.batch_size"
+        )
+    notes: list[str] = []
+    if not saved:
+        return notes
+    new = topology_summary(mesh, zero_stage)
+    if saved.get("devices") != new["devices"]:
+        notes.append(
+            f"device count {saved.get('devices')} -> {new['devices']} "
+            f"(ZeRO shard layout rebuilt for the new mesh; orbax reshards "
+            f"the arrays natively on restore)"
+        )
+    if saved.get("mesh") != new["mesh"]:
+        notes.append(f"mesh axes {saved.get('mesh')} -> {new['mesh']}")
+    if saved.get("zero_stage") != new["zero_stage"]:
+        notes.append(
+            f"zero_stage {saved.get('zero_stage')} -> {new['zero_stage']} "
+            f"(same state tree, different layout — restore reshards)"
+        )
+    if saved.get("processes") != new["processes"]:
+        notes.append(
+            f"process count {saved.get('processes')} -> {new['processes']}"
+        )
+    return notes
+
+
 def restrict_spec(spec: P, axes: set) -> P:
     """Keep only the entries of ``spec`` whose axes are all in ``axes``;
     everything else becomes None (auto/replicated).

@@ -536,6 +536,66 @@ def _make_explicit_zero_step(
     )
 
 
+def make_replica_audit(mesh: Mesh, plan: ShardingPlan) -> Optional[Callable]:
+    """Trace-time cross-replica agreement check over the ZeRO (data/fsdp)
+    axes: ``audit(state) -> bool`` True when any DP replica's copy of the
+    REPLICATED state leaves disagrees bit-for-bit with the others.
+
+    Silent data corruption that desyncs one replica is invisible to GSPMD —
+    XLA *assumes* replicated operands are identical, so a flipped bit on one
+    device quietly forks that replica's trajectory until the loss curves
+    split (arXiv:2004.13336's cross-replica sharding makes the redundant
+    copies explicit; this is the cheap agreement check that redundancy
+    affords). Mechanics: a ``shard_map`` over the zero axes lets each device
+    checksum ITS OWN physical copy (``detect.leaf_checksum`` — exact uint32
+    bit-sums, so healthy replicas agree exactly); a scalar ``all_gather``
+    compares them. Only leaves replicated over the zero axes participate —
+    ZeRO-sharded leaves have no redundant copy to compare (at stage >= 1
+    that is the optimizer state, at stage 3 also the params; the audit then
+    covers whatever replication remains, params at stage <= 2 being the
+    expensive tree that matters). Cost: one bandwidth-bound read of the
+    replicated leaves + one scalar all-gather — run every
+    ``audit_frequency`` steps under ``lax.cond``, riding the anomaly-guard
+    carry with NO extra host sync.
+
+    Returns None when the mesh has no ZeRO-axis redundancy to audit
+    (zero world of 1)."""
+    zaxes = zero_axes(mesh)
+    zsize = math.prod(mesh.shape[a] for a in zaxes)
+    if zsize <= 1:
+        return None
+    zset = set(zaxes)
+    specs = TrainState(
+        step=P(),
+        params=jax.tree.map(
+            lambda ns: shd.restrict_spec(ns.spec, zset), plan.state.params
+        ),
+        opt_state=jax.tree.map(
+            lambda ns: shd.restrict_spec(ns.spec, zset), plan.state.opt_state
+        ),
+    )
+
+    def core(state: TrainState):
+        from zero_transformer_tpu.resilience.detect import leaf_checksum
+
+        total = jnp.zeros((), jnp.uint32)
+        for leaf, spec in zip(jax.tree.leaves(state), jax.tree.leaves(specs)):
+            if any(e is not None for e in spec):
+                continue  # ZeRO-sharded: no redundant copy to compare
+            total = total + leaf_checksum(leaf)
+        gathered = jax.lax.all_gather(total, zaxes if len(zaxes) > 1 else zaxes[0])
+        return (gathered.reshape(-1) != gathered.reshape(-1)[0]).any()
+
+    return shard_map(
+        core,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=P(),
+        axis_names=frozenset(zaxes),
+        check_vma=False,
+    )
+
+
 def make_eval_step(model: nn.Module, mesh: Mesh, plan: ShardingPlan) -> Callable:
     """Jitted eval: mean next-token loss over a [batch, seq] batch
     (reference ``xmap_train_functions.py:94-107``)."""

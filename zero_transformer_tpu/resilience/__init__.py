@@ -18,8 +18,16 @@ full fault → detection → response → recovery matrix):
                   and retryable-vs-fatal exception classification
                   (``train.py --supervise``);
 - ``chaos``     — fault injection (NaN step, loader error, SIGTERM, failed
-                  or slow checkpoint write, hung step) proving the above in
-                  ``tests/test_resilience.py``.
+                  or slow checkpoint write, hung step, truncated/bit-flipped
+                  checkpoint dirs, single-replica state desync) proving the
+                  above in ``tests/test_resilience.py``.
+
+The TRUSTWORTHY-RESTORE layer (integrity manifests + quarantine/fallback in
+``checkpoint.py``, elastic topology validation in ``parallel.sharding``, the
+cross-replica divergence audit in ``parallel.zero.make_replica_audit``)
+builds on these: a corrupt step dir is quarantined at restore instead of
+crash-looping the supervisor on the same artifact, and an SDC-desynced
+replica trips the audit within ``audit_frequency`` steps instead of never.
 
 The SERVING counterpart — engine lifecycle, decode-tick supervision,
 graceful drain, hot weight reload, deadline-aware shedding — lives in
@@ -57,8 +65,10 @@ _LAZY = {
     "AnomalyGuard": "anomaly",
     "HostSnapshot": "anomaly",
     "nonfinite_rows": "detect",
+    "leaf_checksum": "detect",
     "ChaosMonkey": "chaos",
     "Fault": "chaos",
+    "perturb_one_replica": "chaos",
     "Supervisor": "supervisor",
     "classify": "supervisor",
     "Watchdog": "watchdog",

@@ -51,6 +51,9 @@ class AnomalyStats:
     streak: int  # consecutive flagged steps ending at the current step
     loss_ema: float
     grad_ema: float
+    # cross-replica divergence audits that failed (0 when the audit is off
+    # or the mesh has no ZeRO-axis redundancy); see zero.make_replica_audit
+    audit_failures: int = 0
 
 
 class AnomalyGuard:
@@ -70,6 +73,22 @@ class AnomalyGuard:
         self.plan = plan
         self.batch_sharding = batch_sharding
         self._replicated = NamedSharding(mesh, P())
+        # periodic cross-replica agreement check (None: off, or no DP
+        # redundancy on this mesh) — see parallel.zero.make_replica_audit
+        self._audit = None
+        if cfg.audit_frequency > 0:
+            from zero_transformer_tpu.parallel.zero import make_replica_audit
+
+            self._audit = make_replica_audit(mesh, plan)
+            if self._audit is None:
+                import logging
+
+                logging.getLogger("zero_transformer_tpu").warning(
+                    "audit_frequency=%d requested but this mesh has no "
+                    "ZeRO-axis redundancy (zero world of 1) — there are no "
+                    "replicated copies to cross-check, so the replica audit "
+                    "is INACTIVE", cfg.audit_frequency,
+                )
 
     def init_carry(self) -> dict:
         zero = lambda dt: jnp.zeros((), dt)  # noqa: E731
@@ -81,6 +100,8 @@ class AnomalyGuard:
             # clean steps absorbed into the EMAs (spike checks arm at
             # spike_warmup_steps)
             "seen": zero(jnp.int32),
+            # failed cross-replica agreement checks (audit_frequency > 0)
+            "audit_failures": zero(jnp.int32),
         }
         return jax.device_put(carry, self._replicated)
 
@@ -112,6 +133,8 @@ class AnomalyGuard:
             "loss_ema": ema(carry["loss_ema"], loss),
             "grad_ema": ema(carry["grad_ema"], grad_norm),
             "seen": carry["seen"] + (~bad).astype(jnp.int32),
+            # passed through; the audit increment happens in wrap()
+            "audit_failures": carry["audit_failures"],
         }
 
     def wrap(self, train_step: Callable) -> Callable:
@@ -128,9 +151,25 @@ class AnomalyGuard:
             )
             metrics = dict(metrics)
             metrics["anomaly"] = bad.astype(jnp.float32)
-            return guarded_state, metrics, self._advance_carry(
+            new_carry = self._advance_carry(
                 bad, metrics["loss"], metrics["grad_norm"], carry
             )
+            if self._audit is not None:
+                # periodic bit-exact cross-replica agreement check on the
+                # state that PERSISTS (post-select), gated in-graph so the
+                # replicated-leaf read only happens on audit steps
+                do = (guarded_state.step % self.cfg.audit_frequency) == 0
+                diverged = jax.lax.cond(
+                    do,
+                    self._audit,
+                    lambda s: jnp.zeros((), jnp.bool_),
+                    guarded_state,
+                )
+                metrics["replica_diverged"] = diverged.astype(jnp.float32)
+                new_carry["audit_failures"] = (
+                    new_carry["audit_failures"] + diverged.astype(jnp.int32)
+                )
+            return guarded_state, metrics, new_carry
 
         rep = self._replicated
         return _with_ambient_mesh(
@@ -152,6 +191,7 @@ class AnomalyGuard:
             streak=int(host["streak"]),
             loss_ema=float(host["loss_ema"]),
             grad_ema=float(host["grad_ema"]),
+            audit_failures=int(host.get("audit_failures", 0)),
         )
 
 

@@ -47,12 +47,18 @@ class Fault:
     """One injectable fault.
 
     kind: "nan_step" | "loader_error" | "sigterm" | "ckpt_fail" |
-          "ckpt_slow" | "hang"
+          "ckpt_slow" | "ckpt_truncate" | "ckpt_bitflip" | "hang" |
+          "replica_perturb"
     step: step at which to fire. For "nan_step" this is matched against the
       in-graph ``state.step`` (0-based step being computed); for host faults
       it is the 1-based count of completed steps; for "loader_error" the
       batch index (0-based) whose fetch raises; for "ckpt_fail"/"ckpt_slow"
-      the first save call with ``step >= fault.step`` fires.
+      the first save call with ``step >= fault.step`` fires; for
+      "ckpt_truncate"/"ckpt_bitflip" the first save that actually WRITES at
+      ``step >= fault.step`` has its just-committed step dir corrupted (a
+      torn write / storage bit rot, after the fact); for "replica_perturb"
+      the first completed step ``>= fault.step`` desyncs one device's copy
+      of a replicated param leaf (silent data corruption on one replica).
     duration: consecutive steps poisoned ("nan_step") or seconds
       ("ckpt_slow"/"hang" cap).
     exc: exception type for "loader_error"/"ckpt_fail".
@@ -87,8 +93,47 @@ class _ChaosLoader:
             yield batch
 
 
+def corrupt_step_dir(step_dir, kind: str) -> List[str]:
+    """Storage-level corruption of a COMMITTED step directory.
+
+    ``ckpt_truncate``: halve every file under the ``state`` item — a torn
+    write / partial upload (restore raises mid-read). ``ckpt_bitflip``: flip
+    one bit every 64 bytes across the back half of EVERY ocdbt data blob
+    (``d/`` dirs) — every copy, because ocdbt stores small arrays
+    redundantly and a flip in only the unread duplicate is absorbed; a
+    single flip can also land in dead padding and legitimately change
+    nothing, hence the sparse burst. Where the burst hits array bytes the
+    restore comes back *silently wrong* (the case only the digest manifest
+    catches); where it hits ocdbt framing the read raises. Both routes land
+    in ``restore_verified``'s quarantine path. Returns the files touched."""
+    from pathlib import Path
+
+    state_dir = Path(str(step_dir)) / "state"
+    files = sorted(
+        (f for f in state_dir.rglob("*") if f.is_file()),
+        key=lambda f: -f.stat().st_size,
+    )
+    touched = []
+    if kind == "ckpt_truncate":
+        for f in files:
+            data = f.read_bytes()
+            f.write_bytes(data[: len(data) // 2])
+            touched.append(str(f))
+    else:  # ckpt_bitflip
+        for f in files:
+            if f.parent.name != "d":
+                continue  # only data blobs: keep the corruption "silent"
+            data = bytearray(f.read_bytes())
+            for off in range(len(data) // 2, len(data), 64):
+                data[off] ^= 0x01
+            f.write_bytes(bytes(data))
+            touched.append(str(f))
+    return touched
+
+
 class _ChaosCheckpoint:
-    """CheckpointManager proxy: failing or slow ``save`` at a chosen step."""
+    """CheckpointManager proxy: failing, slow, or corrupting ``save`` at a
+    chosen step."""
 
     def __init__(self, inner, faults: List[Fault], monkey: "ChaosMonkey"):
         self._inner = inner
@@ -100,14 +145,31 @@ class _ChaosCheckpoint:
 
     def save(self, step: int, state, meta=None, force: bool = False):
         for f in self._faults:
-            if f.fired or step < f.step:
+            if f.fired or step < f.step or f.kind in ("ckpt_truncate",
+                                                      "ckpt_bitflip"):
                 continue
             self._monkey.record(f)
             if f.kind == "ckpt_fail":
                 raise f.exc(f"{f.message} (checkpoint save at step {step})")
             log.warning("chaos: delaying checkpoint save %.1fs", f.duration)
             time.sleep(f.duration)
-        return self._inner.save(step, state, meta=meta, force=force)
+        saved = self._inner.save(step, state, meta=meta, force=force)
+        if saved:
+            for f in self._faults:
+                if f.fired or step < f.step or f.kind not in (
+                    "ckpt_truncate", "ckpt_bitflip"
+                ):
+                    continue
+                # corrupt AFTER the commit: the fault models storage rot /
+                # a torn write on an already-"successful" checkpoint
+                self._inner.wait()
+                self._monkey.record(f)
+                touched = corrupt_step_dir(self._inner.step_path(step), f.kind)
+                log.warning(
+                    "chaos: %s corrupted step %d (%d file(s))",
+                    f.kind, step, len(touched),
+                )
+        return saved
 
 
 class ChaosMonkey:
@@ -176,10 +238,24 @@ class ChaosMonkey:
         return _ChaosLoader(loader, faults[0], self)
 
     def wrap_checkpoint(self, ckpt):
-        faults = self._of_kind("ckpt_fail", "ckpt_slow")
+        faults = self._of_kind(
+            "ckpt_fail", "ckpt_slow", "ckpt_truncate", "ckpt_bitflip"
+        )
         if not faults:
             return ckpt
         return _ChaosCheckpoint(ckpt, faults, self)
+
+    def perturb_state(self, step: int, state):
+        """``replica_perturb``: desync ONE device's copy of a replicated
+        param leaf — bit-level silent data corruption on one DP replica.
+        Called by the trainer after each completed step; returns the state
+        unchanged unless a pending fault fires."""
+        for f in self._of_kind("replica_perturb"):
+            if f.fired or step < f.step:
+                continue
+            self.record(f)
+            state = perturb_one_replica(state)
+        return state
 
     def on_step(self, step: int) -> None:
         """Host-side faults, called by the trainer after each completed step."""
@@ -200,3 +276,51 @@ class ChaosMonkey:
                     "chaos: hang cap %.0fs elapsed without watchdog abort",
                     float(f.duration),
                 )
+
+
+def perturb_one_replica(state):
+    """Flip one element of ONE device's physical copy of the first
+    replicated, multi-device param leaf (everything else — and every other
+    device's copy — is byte-identical). This is what SDC on a single
+    host/device does to a "replicated" array: XLA assumes the copies are
+    identical, so nothing notices until the cross-replica audit compares
+    them (or the loss curves fork). Rebuilds the leaf with
+    ``jax.make_array_from_single_device_arrays`` and routes the result
+    through ``ensure_donatable`` (the per-device ``device_put`` buffers may
+    be zero-copy host views, and the train step donates this state)."""
+    import numpy as np
+
+    from zero_transformer_tpu.parallel.zero import TrainState
+    from zero_transformer_tpu.utils.jax_compat import ensure_donatable
+
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    target = None
+    for idx, leaf in enumerate(leaves):
+        if (
+            getattr(leaf, "sharding", None) is not None
+            and leaf.sharding.is_fully_replicated
+            and len(leaf.sharding.device_set) > 1
+            and leaf.size > 0
+        ):
+            target = idx
+            break
+    if target is None:
+        raise ValueError(
+            "replica_perturb: no replicated multi-device param leaf to "
+            "desync (single-device mesh, or fully sharded params)"
+        )
+    leaf = leaves[target]
+    bufs = []
+    for i, shard in enumerate(leaf.addressable_shards):
+        arr = np.array(shard.data, copy=True)
+        if i == 0:
+            flat = arr.reshape(-1)
+            flat[0] = flat[0] + np.asarray(1.0, arr.dtype)
+        bufs.append(jax.device_put(arr, shard.device))
+    leaves[target] = jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, bufs
+    )
+    perturbed = jax.tree_util.tree_unflatten(treedef, leaves)
+    return ensure_donatable(
+        TrainState(step=state.step, params=perturbed, opt_state=state.opt_state)
+    )

@@ -22,3 +22,41 @@ def nonfinite_rows(x: jax.Array) -> jax.Array:
     serving tick: a [S, V] -> [S] reduction computed inside the fused step
     and fetched alongside the sampled tokens in the same device_get."""
     return ~jnp.isfinite(x).reshape(x.shape[0], -1).all(axis=1)
+
+
+def leaf_checksum(x: jax.Array) -> jax.Array:
+    """Exact uint32 wrap-sum of the raw BITS of ``x`` (trace-time helper).
+
+    The one content-fingerprint definition shared by checkpoint integrity
+    manifests (``checkpoint.tree_digests``) and the cross-replica divergence
+    audit (``parallel.zero.make_replica_audit``). Properties that both rely
+    on:
+
+    - **bit-exact**: integer wrap-around addition, no float rounding — a
+      single flipped bit changes the sum by ±2^k mod 2^32, never by "less
+      than an ulp";
+    - **layout/topology invariant**: addition is commutative and exact, so
+      the digest of a logical array is identical whether it is computed on
+      1 device or 64, sharded or replicated — which is what lets an
+      8-device-saved manifest verify a restore onto a 4-device mesh;
+    - **cheap**: one bandwidth-bound read of the tensor.
+
+    Two flips that exactly cancel (same bit position, opposite direction)
+    collide — acceptable for SDC detection, where the failure mode is a
+    single flipped bit or a torn write, not an adversary.
+
+    64-bit dtypes are bitcast to uint32 PAIRS before summing (a single
+    uint64 -> uint32 narrowing would drop bits 32-63 entirely, making
+    high-word flips invisible); ``checkpoint._np_checksum`` mirrors the
+    same word split so both digest paths agree bit-for-bit.
+    """
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    nbits = jnp.dtype(x.dtype).itemsize * 8
+    if nbits >= 64:
+        # bitcast to a SMALLER width appends a trailing dim: every 32-bit
+        # word of the 64-bit value participates in the sum
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    else:
+        u = jax.lax.bitcast_convert_type(x, jnp.dtype(f"uint{nbits}"))
+    return jnp.sum(u.astype(jnp.uint32), dtype=jnp.uint32)

@@ -39,6 +39,52 @@ from zero_transformer_tpu.utils.jax_compat import ensure_donatable
 log = logging.getLogger("zero_transformer_tpu")
 
 
+def remap_loader_state(
+    meta: Optional[dict],
+    batch_size: int,
+    train_context: int,
+    accum_steps: int = 1,
+) -> Optional[dict]:
+    """Map a saved loader position onto the CURRENT run's batch geometry.
+
+    The loader position is stored in GLOBAL optimizer steps
+    (``steps_consumed``; each consumes ``batch_size * accum_steps``
+    sequences of ``train_context`` tokens), so a topology change alone
+    (different device/host count) needs NO remap: every process assembles
+    the same global batch and the global-token trajectory continues exactly
+    where it left off. When the geometry changed — ``batch_size``,
+    ``train_context``, or ``gradient_accumulation_steps`` (the canonical
+    elastic move is halving the devices and doubling accum to preserve the
+    global batch) — the position is remapped by TOKEN count, rounding DOWN
+    to the previous whole-step boundary: up to one optimizer step's tokens
+    are replayed, never skipped (the batch-boundary semantics documented in
+    docs/RESILIENCE.md and pinned in tests/test_elastic.py)."""
+    loader_state = (meta or {}).get("loader")
+    if not loader_state:
+        return None
+    sched = (meta or {}).get("schedule") or {}
+    old_bs = int(sched.get("batch_size", batch_size))
+    old_ctx = int(sched.get("train_context", train_context))
+    old_accum = int(sched.get("accum_steps", accum_steps))
+    if (old_bs, old_ctx, old_accum) == (batch_size, train_context, accum_steps):
+        return loader_state
+    steps = int(loader_state.get("steps_consumed", 0))
+    tokens = steps * old_bs * old_accum * old_ctx
+    new_steps, replayed = divmod(
+        tokens, batch_size * accum_steps * train_context
+    )
+    if replayed:
+        log.warning(
+            "loader remap: batch geometry changed (%d seq x %d accum x %d "
+            "tok -> %d x %d x %d); resuming at optimizer step %d replays "
+            "%d tokens (position rounds DOWN to a step boundary — replay, "
+            "never skip)",
+            old_bs, old_accum, old_ctx, batch_size, accum_steps,
+            train_context, new_steps, replayed,
+        )
+    return {"steps_consumed": int(new_steps)}
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainingBuild:
     """Mesh → model → optimizer → plan → compiled-step builders for a config.
@@ -188,6 +234,7 @@ class Trainer:
             keep=cfg.checkpoint.keep,
             save_frequency=cfg.checkpoint.save_frequency,
             async_save=cfg.checkpoint.async_save,
+            integrity=cfg.checkpoint.integrity,
         )
         # fail fast on a bad checkpoint destination (wrong bucket, perms)
         # before any compute is spent — the manager is otherwise lazy
@@ -208,6 +255,8 @@ class Trainer:
         self.preempted = False
         self.last_step: Optional[int] = None
         self.resilience_report: Dict[str, Any] = {}
+        # filled by a verified resume (quarantine/fallback counters)
+        self._restore_report: Optional[ckpt_lib.RestoreReport] = None
         from zero_transformer_tpu.config import flatten_config
 
         self.metrics = monitoring.MetricsLogger(
@@ -242,23 +291,96 @@ class Trainer:
             self.model, self.tx, self.plan, self.sample_shape
         )
 
+    def _save_meta(self) -> dict:
+        """Per-save JSON metadata: loader position + the topology and batch
+        geometry the checkpoint was written under (what elastic resume
+        validates and remaps against)."""
+        from zero_transformer_tpu.parallel import sharding as shd
+
+        return {
+            "loader": self.train_loader.state(),
+            "topology": shd.topology_summary(self.mesh, self.zero_stage),
+            "schedule": {
+                "batch_size": self.cfg.training.batch_size,
+                "train_context": self.cfg.training.train_context,
+                "accum_steps": max(
+                    self.cfg.training.gradient_accumulation_steps, 1
+                ),
+            },
+        }
+
+    def _check_restore_meta(self, meta: dict) -> None:
+        """Pre-restore elastic-topology validation (raises ValueError — fatal
+        to the supervisor — on genuinely incompatible topologies, BEFORE any
+        array IO or pjit compilation touches the checkpoint)."""
+        from zero_transformer_tpu.parallel import sharding as shd
+
+        notes = shd.check_elastic_compat(
+            (meta or {}).get("topology"),
+            self.mesh,
+            self.zero_stage,
+            self.cfg.training.batch_size,
+        )
+        for note in notes:
+            log.warning("elastic resume: %s", note)
+
     def init_state(self) -> TrainState:
         """Fresh init, or resume / warm-init per the checkpoint config."""
         ck = self.cfg.checkpoint
         if ck.resume and self.ckpt.latest_step() is not None:
-            state, meta = self.ckpt.restore(self.abstract_state())
+            # verified restore: digest-checks every leaf against the step's
+            # integrity manifest, quarantines corrupt step dirs, falls back
+            # to the newest verified older step, and validates/reshards
+            # across topology changes (elastic ZeRO resume)
+            state, meta, report = self.ckpt.restore_verified(
+                self.abstract_state(),
+                check_meta=self._check_restore_meta,
+                on_event=self.metrics.event,
+            )
+            self._restore_report = report
             # restored buffers may be zero-copy views the runtime does not
             # own; the train step donates this state, so force ownership
             # before it ever reaches a donating jit (utils/jax_compat.py)
             state = ensure_donatable(state)
             step = int(state.step)
-            loader_state = (meta or {}).get("loader")
+            loader_state = remap_loader_state(
+                meta,
+                self.cfg.training.batch_size,
+                self.cfg.training.train_context,
+                max(self.cfg.training.gradient_accumulation_steps, 1),
+            )
             if loader_state:
                 self.train_loader.restore(loader_state)
             else:
                 self.train_loader.skip(step)
-            log.info("resumed from step %d", step)
+            log.info(
+                "resumed from step %d (verified in %.1f ms; %d quarantined, "
+                "fell back %d step(s))",
+                step, report.verify_ms, len(report.quarantined),
+                report.fallback_steps,
+            )
         else:
+            if ck.resume:
+                incomplete = self.ckpt.incomplete_steps()
+                if incomplete:
+                    # --resume with step dirs on disk but none COMPLETE:
+                    # almost always a crash mid-first-save (fresh init is
+                    # correct and save() will quarantine the leftovers), but
+                    # if these were real checkpoints whose commit markers a
+                    # backup tool dropped, the operator must know progress
+                    # is being discarded — say so loudly, in metrics too
+                    log.error(
+                        "--resume: step dir(s) %s exist under %s but none "
+                        "pass the completeness check (no commit markers) — "
+                        "starting FRESH from step 0. If these are real "
+                        "checkpoints, restore their _CHECKPOINT_METADATA/"
+                        "state/_METADATA files and rerun",
+                        incomplete, self.cfg.checkpoint.directory,
+                    )
+                    self.metrics.event(
+                        "resume_found_only_incomplete_steps", 0,
+                        steps=str(incomplete),
+                    )
             state = init_train_state(
                 self.model, self.tx, self.rng, self.mesh, self.sample_shape, self.plan
             )
@@ -402,9 +524,7 @@ class Trainer:
             return
         step, state = live
         try:
-            self.ckpt.save(
-                step, state, meta={"loader": self.train_loader.state()}, force=True
-            )
+            self.ckpt.save(step, state, meta=self._save_meta(), force=True)
             self.ckpt.wait()
             log.warning("watchdog: force-saved checkpoint at step %d", step)
         except Exception:
@@ -445,6 +565,7 @@ class Trainer:
             guard, step_fn = self._guarded_step()
             carry = guard.init_carry()
         anom_seen = 0
+        audit_seen = 0
         rollbacks = 0
         snapshot = None
         last_snap_step = start
@@ -466,7 +587,17 @@ class Trainer:
         self.preempted = False
         self.last_step = start
         self.resilience_report = {"anomalies": 0, "rollbacks": 0,
-                                  "watchdog_fired": False}
+                                  "watchdog_fired": False,
+                                  "replica_audit_failures": 0}
+        if self._restore_report is not None:
+            # a verified resume's quarantine/fallback work is part of this
+            # run's resilience story — surface it alongside the counters
+            self.resilience_report["ckpt_quarantined"] = len(
+                self._restore_report.quarantined
+            )
+            self.resilience_report["restore_fallback_steps"] = (
+                self._restore_report.fallback_steps
+            )
 
         step = start
         tick_step = start  # step at which the timing window last restarted
@@ -539,6 +670,19 @@ class Trainer:
                     if hbm is not None:
                         payload["hbm_gb"] = hbm
                     payload.update(self._data_fault_payload())
+                    if self.ckpt.last_digest_ms:
+                        # digest time of the most recent manifest-carrying
+                        # save tick (the <5% overhead budget, observable)
+                        payload["ckpt_verify_ms"] = self.ckpt.last_digest_ms
+                    if self._restore_report is not None and (
+                        self._restore_report.quarantined
+                    ):
+                        payload["ckpt_quarantined"] = len(
+                            self._restore_report.quarantined
+                        )
+                        payload["restore_fallback_steps"] = (
+                            self._restore_report.fallback_steps
+                        )
                     if guard is not None:
                         stats = guard.read(carry)  # host sync — log points only
                         new_anoms = stats.count - anom_seen
@@ -550,23 +694,62 @@ class Trainer:
                                 self.resilience_report["anomalies"]
                             )
                             payload["anomaly_streak"] = stats.streak
+                        new_audit = stats.audit_failures - audit_seen
+                        if new_audit > 0:
+                            self.resilience_report["replica_audit_failures"] += (
+                                new_audit
+                            )
+                        if self.resilience_report["replica_audit_failures"]:
+                            payload["replica_audit_failures"] = (
+                                self.resilience_report["replica_audit_failures"]
+                            )
                     self.metrics.log(payload, step, prefix="train")
                     tick_step = step
                     if guard is not None:
-                        state, carry, did_roll = self._handle_anomalies(
-                            stats, new_anoms, state, carry, guard, snapshot,
+                        state, carry, rolled = self._handle_replica_divergence(
+                            new_audit, state, carry, guard, snapshot,
                             rollbacks, step,
                         )
-                        anom_seen = 0 if did_roll else stats.count
-                        if did_roll:
+                        if rolled:
+                            # audit rollback reset the carry; both counters
+                            # restart from zero at the next read
+                            anom_seen = 0
+                            audit_seen = 0
+                        else:
+                            audit_seen = stats.audit_failures
+                            state, carry, rolled = self._handle_anomalies(
+                                stats, new_anoms, state, carry, guard, snapshot,
+                                rollbacks, step,
+                            )
+                            anom_seen = 0 if rolled else stats.count
+                            if rolled:
+                                audit_seen = 0
+                        if rolled:
                             rollbacks += 1
                             self.resilience_report["rollbacks"] = rollbacks
                             paused = True  # exclude rollback time from timing
-                        # mirror a known-good state to host RAM on schedule
+                        # mirror a known-good state to host RAM on schedule.
+                        # With the replica audit active, "known-good" also
+                        # requires a CLEAN audit to have run since the last
+                        # capture: otherwise a desync that happened between
+                        # audits could be captured and later re-replicated
+                        # by the "heal" rollback, baking the corruption into
+                        # every replica. (Residual window: corruption in the
+                        # <= audit_frequency steps since the last clean
+                        # audit can still slip in — the audit bounds it.)
+                        audit_vouched = (
+                            getattr(guard, "_audit", None) is None
+                            or (
+                                new_audit == 0
+                                and step // res.audit_frequency
+                                > last_snap_step // res.audit_frequency
+                            )
+                        )
                         if (
                             snapshot is not None
                             and stats.streak == 0
-                            and not did_roll
+                            and not rolled
+                            and audit_vouched
                             and step - last_snap_step >= res.snapshot_frequency
                         ):
                             snapshot.capture(state)
@@ -576,7 +759,7 @@ class Trainer:
                     self.metrics.log(self.evaluate(state), step, prefix="validation")
                     paused = True
 
-                if self.ckpt.save(step, state, meta={"loader": self.train_loader.state()}):
+                if self.ckpt.save(step, state, meta=self._save_meta()):
                     paused = True
                 if paused:
                     # exclude eval/checkpoint wall time from the throughput window
@@ -585,6 +768,10 @@ class Trainer:
 
                 if self._chaos is not None:
                     self._chaos.on_step(step)
+                    # replica_perturb chaos: desync one DP replica's copy of
+                    # the (logically replicated) state — the SDC the audit
+                    # exists to catch. No-op without such a fault.
+                    state = self._chaos.perturb_state(step, state)
                 if preempted.is_set():
                     log.warning("preemption: saving at step %d and stopping", step)
                     self.metrics.event("preemption", step)
@@ -610,13 +797,83 @@ class Trainer:
             if watchdog is not None:
                 watchdog.stop()
             restore_handler()
+        # drain any in-flight async save BEFORE the latest_step comparison:
+        # latest_step() now checks ON-DISK commit markers, and a step whose
+        # background commit hasn't landed yet would read as absent — the
+        # redundant force-save would then raise StepAlreadyExistsError
+        self.ckpt.wait()
         if self.ckpt.latest_step() != step:
-            self.ckpt.save(
-                step, state, meta={"loader": self.train_loader.state()}, force=True
-            )
+            self.ckpt.save(step, state, meta=self._save_meta(), force=True)
         self.ckpt.wait()
         self.state = state
         return state
+
+    def _rollback_to_snapshot(self, state, guard, snapshot):
+        """Restore params/opt-state from the host-RAM snapshot, KEEPING the
+        current step counter (the loader and LR schedule move forward — the
+        offending window is never replayed), with a fresh guard carry. The
+        snapshot's ``restore()`` routes through ``ensure_donatable`` (the
+        re-placed buffers enter the donating train step) and its
+        ``device_put`` re-replicates ONE host copy onto every device —
+        which is also what makes rollback heal a replica desync."""
+        restored = snapshot.restore()
+        state = TrainState(
+            step=state.step,
+            params=restored.params,
+            opt_state=restored.opt_state,
+        )
+        return state, guard.init_carry()
+
+    def _handle_replica_divergence(
+        self, new, state, carry, guard, snapshot, rollbacks, step
+    ):
+        """Escalation when the cross-replica audit tripped since the last
+        log point. A desynced replica cannot be skipped past (every
+        subsequent step forks further) — the options are HEAL by re-placing
+        identical copies from the host snapshot (``anomaly_response:
+        rollback``; a ``device_put`` from one host buffer re-replicates
+        bit-identical state on every device) or HALT so the operator swaps
+        the suspect host. Returns (state, carry, did_rollback)."""
+        if new <= 0:
+            return state, carry, False
+        res = self.cfg.resilience
+        good = self.ckpt.latest_step()
+        log.error(
+            "replica audit: %d failed agreement check(s) by step %d — one "
+            "DP replica's state no longer matches its peers (silent data "
+            "corruption)", new, step,
+        )
+        self.metrics.event(
+            "replica_divergence", step, new_failures=new,
+            total=self.resilience_report["replica_audit_failures"],
+        )
+        from zero_transformer_tpu.resilience import AnomalyHalt
+
+        if (
+            res.anomaly_response == "rollback"
+            and snapshot is not None
+            and snapshot.captured
+            and rollbacks < res.max_rollbacks
+        ):
+            state, carry = self._rollback_to_snapshot(state, guard, snapshot)
+            log.warning(
+                "replica divergence HEALED by rollback %d/%d: host snapshot "
+                "of step %d re-replicated identical copies at step %d",
+                rollbacks + 1, res.max_rollbacks, snapshot.step, step,
+            )
+            self.metrics.event(
+                "replica_heal_rollback", step,
+                to_step=snapshot.step, rollback=rollbacks + 1,
+            )
+            return state, carry, True
+        raise AnomalyHalt(
+            f"cross-replica divergence at step {step} (audited every "
+            f"{res.audit_frequency} steps): a DP replica's replicated state "
+            f"differs bit-for-bit from its peers — silent data corruption "
+            f"on one host/device. Resume from step {good} (restore "
+            f"re-replicates identical copies); if it recurs, rotate out the "
+            f"suspect host"
+        )
 
     def _handle_anomalies(
         self, stats, new, state, carry, guard, snapshot, rollbacks, step
@@ -657,18 +914,7 @@ class Trainer:
                     f"this divergence is persistent; resume from step {good} "
                     f"with a changed config"
                 )
-            from zero_transformer_tpu.parallel.zero import TrainState as TS
-
-            restored = snapshot.restore()
-            # keep the CURRENT step counter: the loader (and the schedule)
-            # move forward past the offending window — replaying the same
-            # batches into the same state would just diverge again
-            state = TS(
-                step=state.step,
-                params=restored.params,
-                opt_state=restored.opt_state,
-            )
-            carry = guard.init_carry()
+            state, carry = self._rollback_to_snapshot(state, guard, snapshot)
             log.warning(
                 "anomaly rollback %d/%d: restored host snapshot of step %d "
                 "at step %d (loader continues forward)",
