@@ -48,23 +48,38 @@ bench:
 	$(PY) bench.py
 
 # Continuous-batching serving bench: 8 concurrent clients against a 2-slot
-# engine on the CPU test model (chunked prefill on by default), every
-# response verified byte-identical to single-request generate(). Two
-# scenarios: the standard mixed-length run (-> BENCH_serve.json) and the
-# shared-prefix run (N personas x one system prompt -> BENCH_serve_prefix.json,
-# proving prefix-cache hits + the TTFT hit/miss split). A regression guard
-# compares the fresh standard run against the previously committed artifact
-# (>15% on decode_tok_s / itl p99 fails loudly on matching hardware, skips
-# otherwise). Schema pinned by tests/test_serve_bench.py.
+# engine on the CPU test model (paged KV cache + chunked prefill by
+# default), every response verified byte-identical to single-request
+# generate(). Four scenarios:
+#  - headline mixed-length run, SPECULATION ON (greedy so the byte-parity
+#    check stays exact) with an embedded spec-OFF control (no_speculation)
+#    -> BENCH_serve.json — the spec-on/spec-off pair;
+#  - shared-prefix run (N personas x one system prompt; with paging a hit
+#    is a page-refcount bump) -> BENCH_serve_prefix.json;
+#  - capacity sweep: slab vs paged concurrent streams at EQUAL KV budget
+#    -> BENCH_serve_capacity.json (the >=4x concurrency evidence).
+# A regression guard compares the fresh runs against the previously
+# committed artifacts (>15% on decode_tok_s / itl p99 / capacity ratio
+# fails loudly on matching hardware, skips otherwise). Schema pinned by
+# tests/test_serve_bench.py.
 serve-bench:
 	@cp BENCH_serve.json /tmp/_serve_baseline.json 2>/dev/null || true
-	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2
+	@cp BENCH_serve_capacity.json /tmp/_serve_cap_baseline.json 2>/dev/null || true
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
+		--spec-k 4 --greedy --max-new-tokens 32 --cache-len 64
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
 		--shared-prefix --cache-len 64 --out BENCH_serve_prefix.json
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --capacity-sweep \
+		--cache-len 128 --max-new-tokens 8
 	@if [ -f /tmp/_serve_baseline.json ]; then \
 		$(PY) scripts/serve_bench_guard.py /tmp/_serve_baseline.json BENCH_serve.json; \
 	else \
 		echo "serve-bench-guard: no committed baseline; skipping"; \
+	fi
+	@if [ -f /tmp/_serve_cap_baseline.json ]; then \
+		$(PY) scripts/serve_bench_guard.py /tmp/_serve_cap_baseline.json BENCH_serve_capacity.json; \
+	else \
+		echo "serve-bench-guard: no committed capacity baseline; skipping"; \
 	fi
 
 # Retry the bench ladder until a live on-chip measurement lands, then promote

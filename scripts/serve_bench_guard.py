@@ -28,6 +28,21 @@ from pathlib import Path
 TOLERANCE = 0.15
 
 
+def compare_capacity(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
+    """BENCH_serve_capacity.json pair: the paged/slab concurrent-stream
+    ratio at equal KV budget must not shrink past the tolerance."""
+    msgs = []
+    base_ratio = baseline.get("value", 0)
+    fresh_ratio = fresh.get("value", 0)
+    if base_ratio and fresh_ratio < base_ratio * (1 - tolerance):
+        return False, [
+            f"REGRESSION: capacity ratio {fresh_ratio:.2f} < "
+            f"{(1 - tolerance) * 100:.0f}% of baseline {base_ratio:.2f}"
+        ]
+    msgs.append(f"ok: capacity ratio {fresh_ratio:.2f} (baseline {base_ratio:.2f})")
+    return True, msgs
+
+
 def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
     """Returns (ok, messages). ok=True covers both pass and skip."""
     msgs = []
@@ -40,6 +55,10 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
             f"SKIP: hardware mismatch (baseline {base_platform} vs "
             f"fresh {fresh_platform}); not comparable"
         ]
+    if baseline.get("metric") != fresh.get("metric"):
+        return True, ["SKIP: different metrics; not comparable"]
+    if str(baseline.get("metric", "")).startswith("serve_capacity"):
+        return compare_capacity(baseline, fresh, tolerance)
     if baseline.get("workload", "mixed") != fresh.get("workload", "mixed"):
         return True, ["SKIP: different workloads; not comparable"]
 

@@ -27,6 +27,17 @@ REQUIRED_KEYS = {
     "workload", "decode_tok_s", "prefill_chunk", "prefix_cache",
     "itl_ms_decode_only", "prefill_ms_hit_p50", "prefill_ms_miss_p50",
     "no_prefix_cache", "platform",
+    # paged KV + speculation evidence (ISSUE 6): layout, pool pressure, and
+    # draft-and-verify acceptance economics with the spec-off control
+    "kv_layout", "page_size", "page_faults", "pages_reclaimed",
+    "preemptions", "page_pool_util", "cow_copies",
+    "draft_k", "acceptance_rate", "spec_ticks", "no_speculation",
+}
+
+CAPACITY_REQUIRED_KEYS = {
+    "metric", "value", "unit", "model", "kv_budget_tokens", "page_size",
+    "prefill_chunk", "max_new_tokens", "streams_offered", "slab", "paged",
+    "platform", "measured_at_utc",
 }
 
 
@@ -76,6 +87,55 @@ def test_loadgen_artifact_schema_and_invariants(tmp_path):
     assert artifact["chaos"] is False and artifact["errors"] == 0
     assert artifact["final_state"] == "stopped"
     assert artifact["drain_latency_s"] >= 0
+    # paged KV is the loadgen default; speculation off in this run
+    assert artifact["kv_layout"] == "paged" and artifact["page_size"] > 0
+    assert artifact["preemptions"] == 0
+    assert artifact["draft_k"] == 0 and artifact["no_speculation"] is None
+
+
+def test_loadgen_speculative_run_verified_with_acceptance(tmp_path):
+    """--spec-k + --greedy: every trajectory STILL byte-identical to
+    (greedy) generate() — the verify step's exactness contract under real
+    contention — with a nonzero acceptance rate and the spec-OFF control
+    embedded for the A/B."""
+    loadgen = _load()
+    out = tmp_path / "BENCH_serve_spec.json"
+    artifact = loadgen.main([
+        "--requests", "6", "--slots", "2", "--concurrency", "6",
+        "--max-new-tokens", "24", "--cache-len", "64",
+        "--spec-k", "4", "--greedy", "--out", str(out),
+    ])
+    assert artifact["draft_k"] == 4
+    assert artifact["verified"] is True and artifact["mismatches"] == 0
+    assert artifact["completed"] == 6 and artifact["dropped"] == 0
+    assert artifact["spec_ticks"] > 0
+    assert artifact["acceptance_rate"] > 0
+    assert artifact["no_speculation"] is not None
+    assert artifact["no_speculation"]["decode_tok_s"] > 0
+
+
+def test_loadgen_capacity_sweep_artifact(tmp_path):
+    """--capacity-sweep: slab vs paged concurrent streams at EQUAL KV
+    budget. The schema is pinned and the paged engine must beat the slab
+    by the ISSUE 6 bar (>=4x) with zero preemptions (reservation-backed
+    admission means capacity pressure -> waiting, not eviction)."""
+    loadgen = _load()
+    out = tmp_path / "BENCH_serve_capacity.json"
+    artifact = loadgen.main([
+        "--capacity-sweep", "--cache-len", "128", "--max-new-tokens", "8",
+        "--capacity-streams", "20", "--out", str(out),
+    ])
+    on_disk = json.loads(out.read_text())
+    assert on_disk == artifact
+    missing = CAPACITY_REQUIRED_KEYS - set(artifact)
+    assert not missing, f"capacity artifact missing keys: {sorted(missing)}"
+    assert artifact["metric"] == "serve_capacity_streams_ratio"
+    assert artifact["slab"]["completed"] == 20
+    assert artifact["paged"]["completed"] == 20
+    assert artifact["slab"]["capacity_streams"] == artifact["slab"]["slots"]
+    assert artifact["value"] >= 4.0, artifact
+    assert artifact["paged"]["preemptions"] == 0
+    assert 0 < artifact["paged"]["page_pool_util"] <= 1.0
 
 
 def test_loadgen_chaos_run_fails_retryably_and_drains(tmp_path):
@@ -161,6 +221,20 @@ def test_serve_bench_guard_logic():
     assert ok and any("SKIP" in m for m in msgs)
     # pre-platform-field baselines can only skip
     ok, msgs = guard.compare({"decode_tok_s": 600.0, "itl_ms": {"p99": 2.0}}, slow)
+    assert ok and any("SKIP" in m for m in msgs)
+    # capacity artifacts compare on the paged/slab stream ratio
+    cap = {
+        "metric": "serve_capacity_streams_ratio", "value": 8.0,
+        "platform": {"backend": "cpu", "device": "x"},
+    }
+    ok, _ = guard.compare(cap, dict(cap))
+    assert ok
+    ok, msgs = guard.compare(cap, {**cap, "value": 4.0})
+    assert not ok and any("capacity" in m for m in msgs)
+    ok, _ = guard.compare(cap, {**cap, "value": 7.5})  # within tolerance
+    assert ok
+    # mismatched metrics (capacity vs throughput artifact) skip, not fail
+    ok, msgs = guard.compare(cap, base)
     assert ok and any("SKIP" in m for m in msgs)
 
 

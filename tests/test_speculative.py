@@ -187,3 +187,61 @@ def test_speculative_equals_greedy_with_temperature(temperature):
         repetition_penalty=1.2, temperature=temperature,
     )
     np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
+
+
+# ------------------------------------------------ serving-path primitives
+
+
+def test_ngram_propose_prompt_lookup():
+    """Host-side drafting for the SERVING tick: the continuation after the
+    most recent earlier occurrence of the final bigram, offset by one
+    (the tick's first token is sampled in-graph, so the draft skips the
+    position it cannot know)."""
+    from zero_transformer_tpu.inference.speculative import ngram_propose
+
+    #        0  1  2  3  4  5  6  7
+    hist = [5, 9, 2, 4, 7, 5, 9, 2]
+    # final bigram (9, 2) matches at positions 1-2; continuation 4, 7, 5...
+    # skip=1 drops the 4 (it predicts the in-graph sample) -> 7, 5
+    assert ngram_propose(hist, 2) == [7, 5]
+    # a repetition loop proposes the loop itself, full length
+    loop = [3, 1] + [13] * 20
+    assert ngram_propose(loop, 4) == [13, 13, 13, 13]
+    # no earlier match / short history -> zero padding, never an error
+    assert ngram_propose([1, 2, 3], 3) == [0, 0, 0]
+    assert ngram_propose([4], 2) == [0, 0]
+    assert ngram_propose([], 2) == [0, 0]
+    assert ngram_propose(hist, 0) == []
+
+
+def test_rejection_rule_reconstructs_target_distribution():
+    """The serving verify step's acceptance math: a point-mass draft ``d``
+    is accepted with probability p(d); on rejection the NEXT sample draws
+    from the processed logits with ``d`` masked out (the engine's veto).
+    accept*onehot(d) + (1-accept)*residual must equal the target p
+    EXACTLY — the standard rejection-sampling identity, computed with the
+    very transforms the engine uses (process_logits + NEG_INF masking),
+    including their top-k/top-p interaction."""
+    from zero_transformer_tpu.inference.sampling import (
+        NEG_INF,
+        SamplingConfig,
+        process_logits,
+    )
+
+    rng = np.random.default_rng(0)
+    for cfg in (
+        SamplingConfig(temperature=0.9),
+        SamplingConfig(temperature=1.3, top_k=8),
+        SamplingConfig(top_p=0.9),
+    ):
+        logits = jnp.asarray(rng.normal(size=(1, 32)) * 3, jnp.float32)
+        proc = process_logits(logits, cfg)
+        p = np.asarray(jax.nn.softmax(proc, axis=-1))[0]
+        d = int(np.argmax(rng.multinomial(1, p)))  # any in-support draft
+        accept = p[d]
+        vetoed = jnp.where(jnp.arange(32)[None, :] == d, NEG_INF, proc)
+        residual = np.asarray(jax.nn.softmax(vetoed, axis=-1))[0]
+        reconstructed = (1 - accept) * residual
+        reconstructed[d] += accept
+        np.testing.assert_allclose(reconstructed, p, atol=1e-6)
+        assert residual[d] == 0.0  # a rejected draft can never re-emit

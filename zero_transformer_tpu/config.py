@@ -439,6 +439,18 @@ class ServingConfig:
       buckets (legacy path): past it, new prompt lengths round up to an
       already-compiled bucket instead of compiling another program, so
       diverse prompt lengths cannot compile-storm a serving replica.
+    - **kv_layout / page_size / page_pool_tokens**: ``paged`` replaces the
+      fixed [slots, cache_len] KV slab with a block-table paged pool
+      (PagedAttention): HBM is ``page_pool_tokens`` positions regardless of
+      slot count, so concurrency scales with ACTUAL sequence lengths
+      instead of the worst case, and prefix-cache hits become page-refcount
+      bumps instead of span copies. ``page_pool_tokens = 0`` sizes the pool
+      to the exact slab equivalent (slots x cache_len).
+    - **draft_k**: per-tick self-speculative decoding — every decode tick
+      proposes ``draft_k`` tokens per slot (prompt-lookup n-grams) and
+      verifies them in ONE batched forward; greedy output is bit-identical
+      to plain decode, sampling follows the standard rejection rule.
+      Requires repetition_penalty == 1.0. 0 disables.
     """
 
     slots: int = 4
@@ -447,6 +459,10 @@ class ServingConfig:
     prefix_cache_chunks: int = 256
     max_prefill_buckets: int = 8
     drain_deadline_s: float = 30.0
+    kv_layout: str = "paged"
+    page_size: int = 16
+    page_pool_tokens: int = 0
+    draft_k: int = 0
 
     def __post_init__(self):
         if self.slots < 1:
@@ -468,6 +484,35 @@ class ServingConfig:
             raise ValueError("serving.max_prefill_buckets must be >= 1")
         if self.drain_deadline_s < 0:
             raise ValueError("serving.drain_deadline_s must be >= 0")
+        if self.kv_layout not in ("slab", "paged"):
+            raise ValueError(
+                f"serving.kv_layout must be 'slab' or 'paged', got "
+                f"{self.kv_layout!r}"
+            )
+        if self.kv_layout == "paged" and self.prefill_chunk == 0:
+            raise ValueError(
+                "serving.kv_layout='paged' requires prefill_chunk > 0 (the "
+                "legacy one-shot prefill has no block-table path); set "
+                "kv_layout='slab' to keep prefill_chunk=0 (serve --server "
+                "falls back to slab automatically for this combination)"
+            )
+        if self.page_size < 1:
+            raise ValueError("serving.page_size must be >= 1")
+        if (
+            self.kv_layout == "paged"
+            and self.prefill_chunk
+            and self.prefill_chunk % self.page_size
+        ):
+            raise ValueError(
+                "serving.page_size must divide prefill_chunk (page-aligned "
+                "chunk sharing)"
+            )
+        if self.page_pool_tokens < 0:
+            raise ValueError(
+                "serving.page_pool_tokens must be >= 0 (0 = slots x cache_len)"
+            )
+        if self.draft_k < 0:
+            raise ValueError("serving.draft_k must be >= 0 (0 disables)")
 
 
 @dataclasses.dataclass(frozen=True)

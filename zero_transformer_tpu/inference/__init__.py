@@ -1,7 +1,10 @@
 """In-tree TPU inference: KV-cache decode + sampling (replaces the
 reference's CUDA/PyTorch side-car, reference ``torch_compatability/`` +
 ``app.py``)."""
-from zero_transformer_tpu.inference.speculative import generate_speculative
+from zero_transformer_tpu.inference.speculative import (
+    generate_speculative,
+    ngram_propose,
+)
 from zero_transformer_tpu.inference.generate import (
     decode_model,
     generate,
@@ -29,6 +32,7 @@ __all__ = [
     "generate_speculative",
     "generate_tokens",
     "init_cache",
+    "ngram_propose",
     "prefill",
     "process_logits",
     "sample_token",

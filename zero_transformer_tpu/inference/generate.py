@@ -27,9 +27,26 @@ from zero_transformer_tpu.inference.sampling import SamplingConfig, sample_token
 from zero_transformer_tpu.models.gpt import Transformer
 
 
-def decode_model(cfg: ModelConfig, cache_len: int) -> Transformer:
-    """The KV-cache variant of the model (same params as the training one)."""
-    return Transformer(cfg, decode=True, cache_len=cache_len)
+def decode_model(cfg: ModelConfig, cache_len: int, kv_pages=None) -> Transformer:
+    """The KV-cache variant of the model (same params as the training one).
+
+    ``kv_pages=(n_pages, page_size)`` builds the PAGED cache variant for
+    the serving engine: K/V in a global page pool addressed through
+    per-row block tables (``models.gpt.Attention``). ``page_size`` must
+    divide ``cache_len``."""
+    if kv_pages is not None:
+        n_pages, page = kv_pages
+        if page < 1 or n_pages < 2:
+            raise ValueError(
+                f"kv_pages needs page_size >= 1 and n_pages >= 2 (one trash "
+                f"page + one real page), got {kv_pages}"
+            )
+        if cache_len % page:
+            raise ValueError(
+                f"page_size ({page}) must divide cache_len ({cache_len})"
+            )
+        kv_pages = (int(n_pages), int(page))
+    return Transformer(cfg, decode=True, cache_len=cache_len, kv_pages=kv_pages)
 
 
 def serve_mesh(tensor: int):

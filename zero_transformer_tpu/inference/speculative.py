@@ -42,6 +42,47 @@ from zero_transformer_tpu.inference.generate import init_cache, prefill
 from zero_transformer_tpu.models.gpt import Transformer
 
 
+def ngram_propose(history, k: int, n: int = 2, skip: int = 1,
+                  window: int = 512):
+    """Host-side prompt-lookup draft for the SERVING tick: the ``k`` tokens
+    that followed the most recent earlier occurrence of ``history``'s final
+    ``n``-gram.
+
+    ``skip=1`` offsets the continuation by one token: the serving engine
+    samples this tick's first token IN-GRAPH (it is not known when the host
+    drafts), so the draft bets the matched continuation's first token IS
+    that sample and proposes what follows it. A wrong bet just verifies to
+    zero accepted drafts — correctness never depends on draft quality.
+    Falls back to zeros (guaranteed-cheap garbage) when history is short or
+    no earlier match exists. Pure host lists, run per slot per tick between
+    device dispatches — the scan is bounded to the trailing ``window``
+    positions so a long-context slot cannot put O(cache_len) of Python on
+    the decode hot path (recent history carries the repetition signal
+    anyway; a production draft model plugs in via the engine's
+    ``draft_fn``).
+    """
+    if k < 1:
+        return []
+    hist = [int(t) for t in history]
+    H = len(hist)
+    best: list = []
+    if H > n:
+        key = hist[H - n :]
+        # most recent earlier occurrence with a FULL k-token continuation
+        # (the very latest matches sit so close to the end that their
+        # continuation is mostly off-history — on a repetition loop that
+        # would propose nothing); fall back to the longest partial one
+        floor = max(-1, H - n - 1 - window)
+        for start in range(H - n - 1, floor, -1):
+            if hist[start : start + n] == key:
+                out = hist[start + n + skip : start + n + skip + k]
+                if len(out) == k:
+                    return out
+                if len(out) > len(best):
+                    best = out
+    return best + [0] * (k - len(best))
+
+
 def _set_cache_index(cache: Any, value: jax.Array) -> Any:
     """Overwrite every ``cache_index`` leaf (scalar per layer; [L] when the
     layer stack is scanned) with ``value`` — the cache rewind primitive."""

@@ -155,7 +155,21 @@ class LMHead(nn.Module):
 
 
 class Attention(nn.Module):
-    """Causal MHA/GQA with ALiBi or RoPE and a fixed-shape KV cache."""
+    """Causal MHA/GQA with ALiBi or RoPE and a fixed-shape KV cache.
+
+    ``kv_pages=(n_pages, page_size)`` switches the decode cache to a PAGED
+    layout (vLLM-style, Kwon et al. 2309.06180): K/V live in a global page
+    pool ``[n_pages, page_size, KVH, D]`` shared by every row, and each row
+    owns an int32 ``block_table`` ``[B, cache_len // page_size]`` mapping
+    its logical sequence blocks to pool pages. Reads gather the row's pages
+    back into the same ``[B, cache_len, KVH, D]`` view the slab path
+    attends over; writes scatter each token's K/V to
+    ``pool[table[b, pos // P], pos % P]``. Position math, validity masks,
+    the int8 path, and the overflow poison guard are IDENTICAL to the slab
+    cache — paging only changes where the bytes live, so paged decode is
+    bit-exact vs slab decode (tested). Page 0 is the serving layer's trash
+    page: a zeroed block table routes writes somewhere harmless, which is
+    how parked rows ride along in fixed-shape dispatches."""
 
     cfg: ModelConfig
     deterministic: bool = True
@@ -163,6 +177,7 @@ class Attention(nn.Module):
     cache_len: Optional[int] = None  # KV cache capacity; defaults to cfg.max_seq_len
     # mesh with an active `sequence` axis → ring attention (context parallel)
     mesh: Optional[Any] = None
+    kv_pages: Optional[Tuple[int, int]] = None  # (n_pages, page_size)
 
     @nn.compact
     def __call__(self, x: jax.Array, doc_ids: Optional[jax.Array] = None) -> jax.Array:
@@ -193,17 +208,36 @@ class Attention(nn.Module):
         use_cache = False
         offset = 0
         int8_cache = cfg.kv_cache_dtype == "int8"
+        paged = self.decode and self.kv_pages is not None
+        bt = None
         if self.decode:
             max_len = self.cache_len or cfg.max_seq_len
             is_init = not self.has_variable("cache", "cached_key")
             cache_dtype = jnp.int8 if int8_cache else dtype
-            ck = self.variable("cache", "cached_key", jnp.zeros, (B, max_len, KVH, D), cache_dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros, (B, max_len, KVH, D), cache_dtype)
-            if int8_cache:
-                # per-(token, head) symmetric scales; f32 so tiny magnitudes
-                # don't underflow the dequant product
-                ksc = self.variable("cache", "key_scale", jnp.zeros, (B, max_len, KVH, 1), jnp.float32)
-                vsc = self.variable("cache", "value_scale", jnp.zeros, (B, max_len, KVH, 1), jnp.float32)
+            if paged:
+                n_pages, page = self.kv_pages
+                if max_len % page:
+                    raise ValueError(
+                        f"cache_len ({max_len}) must be a multiple of "
+                        f"page_size ({page}) for the paged KV cache"
+                    )
+                n_blocks = max_len // page
+                ck = self.variable("cache", "cached_key", jnp.zeros, (n_pages, page, KVH, D), cache_dtype)
+                cv = self.variable("cache", "cached_value", jnp.zeros, (n_pages, page, KVH, D), cache_dtype)
+                if int8_cache:
+                    ksc = self.variable("cache", "key_scale", jnp.zeros, (n_pages, page, KVH, 1), jnp.float32)
+                    vsc = self.variable("cache", "value_scale", jnp.zeros, (n_pages, page, KVH, 1), jnp.float32)
+                bt = self.variable(
+                    "cache", "block_table", jnp.zeros, (B, n_blocks), jnp.int32
+                )
+            else:
+                ck = self.variable("cache", "cached_key", jnp.zeros, (B, max_len, KVH, D), cache_dtype)
+                cv = self.variable("cache", "cached_value", jnp.zeros, (B, max_len, KVH, D), cache_dtype)
+                if int8_cache:
+                    # per-(token, head) symmetric scales; f32 so tiny magnitudes
+                    # don't underflow the dequant product
+                    ksc = self.variable("cache", "key_scale", jnp.zeros, (B, max_len, KVH, 1), jnp.float32)
+                    vsc = self.variable("cache", "value_scale", jnp.zeros, (B, max_len, KVH, 1), jnp.float32)
             idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
             use_cache = not is_init
             if use_cache:
@@ -226,20 +260,52 @@ class Attention(nn.Module):
             k = apply_rope(k, pos, cfg.rope_theta)  # cache stores rotated keys
 
         if use_cache:
-            if per_slot:
-                # per-row dynamic_update_slice at each slot's own offset
+            if paged:
+                n_pages, page = self.kv_pages
+                n_blocks = (self.cache_len or cfg.max_seq_len) // page
+                # global positions per (row, token) -> (pool page, in-page
+                # slot) through each row's block table. Out-of-range blocks
+                # clip to the last table entry: overflow is already made
+                # loud by the NaN poison guard below, and a parked row's
+                # zeroed table routes the write to the trash page.
+                if per_slot:
+                    pos = offset[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+                else:
+                    pos = jnp.broadcast_to(
+                        offset + jnp.arange(T, dtype=jnp.int32), (B, T)
+                    )
+                page_ids = jnp.take_along_axis(
+                    bt.value, jnp.clip(pos // page, 0, n_blocks - 1), axis=1
+                )  # [B, T]
+                in_page = pos % page
+
                 def write(buf, upd):
-                    return jax.vmap(
-                        lambda c, u, o: jax.lax.dynamic_update_slice(
-                            c, u, (o,) + (0,) * (c.ndim - 1)
-                        )
-                    )(buf, upd, offset)
+                    return buf.at[page_ids, in_page].set(upd.astype(buf.dtype))
+
+                def gather(buf):
+                    # [n_pages, page, ...] -> the row-major [B, cache_len,
+                    # ...] view the slab path attends over
+                    g = jnp.take(buf, bt.value, axis=0)  # [B, n_blocks, page, ...]
+                    return g.reshape((B, n_blocks * page) + buf.shape[2:])
 
             else:
-                def write(buf, upd):
-                    return jax.lax.dynamic_update_slice(
-                        buf, upd, (0, offset) + (0,) * (buf.ndim - 2)
-                    )
+                if per_slot:
+                    # per-row dynamic_update_slice at each slot's own offset
+                    def write(buf, upd):
+                        return jax.vmap(
+                            lambda c, u, o: jax.lax.dynamic_update_slice(
+                                c, u, (o,) + (0,) * (c.ndim - 1)
+                            )
+                        )(buf, upd, offset)
+
+                else:
+                    def write(buf, upd):
+                        return jax.lax.dynamic_update_slice(
+                            buf, upd, (0, offset) + (0,) * (buf.ndim - 2)
+                        )
+
+                def gather(buf):
+                    return buf
 
             if int8_cache:
                 kq, k_scale = _quantize_kv(k)
@@ -251,17 +317,18 @@ class Attention(nn.Module):
                 # dequant fuses into the attention reads; the cache is a
                 # loop carry of the decode while_loop, so XLA cannot hoist
                 # this out — HBM traffic stays at int8 + one f32 scale per
-                # (token, head) instead of bf16 K/V
+                # (token, head) instead of bf16 K/V (paged: the gather moves
+                # int8 bytes + scales, dequant happens on the gathered view)
                 # multiply in f32 (scales are stored f32 for exactly this),
                 # round once at the end
-                k_all = (ck.value.astype(jnp.float32) * ksc.value).astype(dtype)
-                v_all = (cv.value.astype(jnp.float32) * vsc.value).astype(dtype)
+                k_all = (gather(ck.value).astype(jnp.float32) * gather(ksc.value)).astype(dtype)
+                v_all = (gather(cv.value).astype(jnp.float32) * gather(vsc.value)).astype(dtype)
             else:
                 ck.value = write(ck.value, k)
                 cv.value = write(cv.value, v)
-                k_all, v_all = ck.value, cv.value
+                k_all, v_all = gather(ck.value), gather(cv.value)
             idx.value = offset + T
-            max_len_b = ck.value.shape[1]
+            max_len_b = k_all.shape[1]
             if per_slot:
                 kv_valid = (
                     jnp.arange(max_len_b)[None, :] < (offset[:, None] + T)
@@ -354,6 +421,7 @@ class Block(nn.Module):
     decode: bool = False
     cache_len: Optional[int] = None
     mesh: Optional[Any] = None
+    kv_pages: Optional[Tuple[int, int]] = None
 
     @nn.compact
     def __call__(self, carry, _=None):
@@ -368,7 +436,8 @@ class Block(nn.Module):
             x, aux = carry
             doc_ids = None
         x = x + Attention(
-            cfg, self.deterministic, self.decode, self.cache_len, self.mesh, name="attn"
+            cfg, self.deterministic, self.decode, self.cache_len, self.mesh,
+            self.kv_pages, name="attn"
         )(
             _norm(cfg, x.dtype, "ln_attn")(x), doc_ids
         )
@@ -398,6 +467,10 @@ class Transformer(nn.Module):
     # mesh with sequence axis > 1 routes attention through ring attention
     # (context parallelism); None = single-chip / GSPMD-only layouts
     mesh: Optional[Any] = None
+    # (n_pages, page_size): paged KV cache for the serving engine — K/V in
+    # a global page pool addressed through per-row block tables (see
+    # Attention). None = the classic [B, cache_len] slab.
+    kv_pages: Optional[Tuple[int, int]] = None
 
     @nn.compact
     def __call__(
@@ -516,13 +589,14 @@ class Transformer(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, not train, self.decode, self.cache_len, self.mesh, name="blocks")
+            )(cfg, not train, self.decode, self.cache_len, self.mesh,
+              self.kv_pages, name="blocks")
             carry, _ = stack(carry, None)
         else:
             for i in range(cfg.n_layers):
                 carry, _ = block_cls(
                     cfg, not train, self.decode, self.cache_len, self.mesh,
-                    name=f"block_{i}",
+                    self.kv_pages, name=f"block_{i}",
                 )(carry, None)
         h, aux = carry[0], carry[1]
 

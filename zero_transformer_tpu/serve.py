@@ -314,6 +314,23 @@ def _server(gen: TextGenerator, args) -> None:
         repetition_penalty=args.repetition_penalty, greedy=args.greedy,
         top_k_impl=gen.top_k_impl,
     )
+    kv_layout = args.kv_layout
+    if kv_layout == "paged" and args.prefill_chunk == 0:
+        print(
+            "serve: --prefill-chunk 0 (legacy one-shot prefill) has no "
+            "block-table path; falling back to --kv-layout slab",
+            flush=True,
+        )
+        kv_layout = "slab"
+    draft_k = args.draft_k
+    if draft_k and args.repetition_penalty != 1.0:
+        print(
+            "serve: --draft-k requires --repetition-penalty 1.0 (the batched "
+            "verify step cannot emulate the in-block penalty); speculation "
+            "DISABLED for this run",
+            flush=True,
+        )
+        draft_k = 0
     engine = ServingEngine(
         gen.cfg,
         gen.params,
@@ -328,6 +345,10 @@ def _server(gen: TextGenerator, args) -> None:
         prefill_chunk=args.prefill_chunk,
         prefix_cache_chunks=args.prefix_cache if args.prefill_chunk else 0,
         max_prefill_buckets=args.max_prefill_buckets,
+        kv_layout=kv_layout,
+        page_size=args.page_size,
+        page_pool_tokens=args.page_pool_tokens,
+        draft_k=draft_k,
     )
     run_server(
         engine, gen.tokenizer, host=args.host, port=args.port,
@@ -459,6 +480,29 @@ def main(argv=None) -> None:
                         "LRU: repeated system prompts skip straight to "
                         "their first novel chunk (0 = off; requires "
                         "--prefill-chunk > 0; flushed on hot reload)")
+    p.add_argument("--kv-layout", default=serving_defaults.kv_layout,
+                   choices=("slab", "paged"),
+                   help="KV cache layout: 'paged' (default) = block-table "
+                        "page pool (PagedAttention) — HBM scales with ACTUAL "
+                        "sequence lengths, not slots x cache_len, and prefix "
+                        "hits are page-refcount bumps; 'slab' = the classic "
+                        "fixed [slots, cache_len] rows")
+    p.add_argument("--page-size", type=int,
+                   default=serving_defaults.page_size,
+                   help="tokens per KV page (paged layout); must divide "
+                        "--prefill-chunk and the cache length")
+    p.add_argument("--page-pool-tokens", type=int,
+                   default=serving_defaults.page_pool_tokens,
+                   help="total page-pool capacity in token positions "
+                        "(0 = the slab-equivalent slots x cache_len); at a "
+                        "fixed budget, more concurrent streams fit whenever "
+                        "real sequences run shorter than cache_len")
+    p.add_argument("--draft-k", type=int, default=serving_defaults.draft_k,
+                   help="speculative serving: verify K prompt-lookup draft "
+                        "tokens per slot per tick in one batched forward "
+                        "(greedy = bit-identical output, sampling = exact "
+                        "rejection rule; needs --repetition-penalty 1.0; "
+                        "0 = off)")
     p.add_argument("--max-prefill-buckets", type=int,
                    default=serving_defaults.max_prefill_buckets,
                    help="cap on distinct compiled one-shot prefill buckets "

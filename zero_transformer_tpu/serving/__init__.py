@@ -22,7 +22,10 @@ from zero_transformer_tpu.serving.engine import (
     RequestHandle,
     ServingEngine,
 )
-from zero_transformer_tpu.serving.prefix_cache import PrefixCache
+from zero_transformer_tpu.serving.prefix_cache import (
+    PagedPrefixIndex,
+    PrefixCache,
+)
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
     DRAINING,
@@ -36,7 +39,12 @@ from zero_transformer_tpu.serving.resilience import (
     ServingChaosMonkey,
 )
 from zero_transformer_tpu.serving.server import ServingServer, run_server
-from zero_transformer_tpu.serving.slots import SlotKVCache, vectorize_index
+from zero_transformer_tpu.serving.slots import (
+    PagedKVCache,
+    PagePool,
+    SlotKVCache,
+    vectorize_index,
+)
 
 __all__ = [
     "DEGRADED",
@@ -46,6 +54,9 @@ __all__ = [
     "STOPPED",
     "CircuitBreaker",
     "Lifecycle",
+    "PagedKVCache",
+    "PagedPrefixIndex",
+    "PagePool",
     "PrefixCache",
     "ReloadError",
     "ServeFault",

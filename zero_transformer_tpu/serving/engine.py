@@ -22,7 +22,16 @@
 - every ``step()`` runs ONE fused decode step across all slots — padded and
   masked so the compiled program is identical whatever the occupancy — then
   retires slots that hit EOS, their token budget, a deadline, or a
-  cancellation;
+  cancellation. With ``draft_k > 0`` the step is the SPECULATIVE twin:
+  ``draft_k`` host-proposed prompt-lookup drafts per slot verified in the
+  same single forward, committing ``1 + n_acc`` tokens per tick (greedy ≡
+  plain decode bit-for-bit; sampling via the standard rejection rule);
+- with ``kv_layout="paged"`` (the serving default at the CLI) the K/V slab
+  is replaced by a block-table paged pool (``slots.PagedKVCache``): KV HBM
+  is ``page_pool_tokens`` positions regardless of slot count, admission
+  reserves each request's worst case so capacity pressure queues instead
+  of faulting, and prefix-cache hits map shared pages by refcount instead
+  of copying spans;
 - each request carries its OWN rng chain and repetition-penalty mask,
   threaded per-slot through the fused step, so its token trajectory is
   IDENTICAL to what single-request ``generate()`` produces with the same
@@ -74,9 +83,15 @@ from zero_transformer_tpu.inference.generate import (
     decode_model,
     init_cache,
 )
-from zero_transformer_tpu.inference.sampling import SamplingConfig, sample_token
+from zero_transformer_tpu.inference.sampling import (
+    NEG_INF,
+    SamplingConfig,
+    process_logits,
+    sample_token,
+)
+from zero_transformer_tpu.inference.speculative import ngram_propose
 from zero_transformer_tpu.resilience.detect import nonfinite_rows
-from zero_transformer_tpu.serving.prefix_cache import PrefixCache
+from zero_transformer_tpu.serving.prefix_cache import PagedPrefixIndex, PrefixCache
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
     DRAINING,
@@ -89,7 +104,13 @@ from zero_transformer_tpu.serving.resilience import (
     infeasible_deadline,
     validate_reload,
 )
-from zero_transformer_tpu.serving.slots import INDEX_LEAVES, SlotKVCache, _leaf_name
+from zero_transformer_tpu.serving.slots import (
+    INDEX_LEAVES,
+    TABLE_LEAF,
+    PagedKVCache,
+    SlotKVCache,
+    _leaf_name,
+)
 
 # request terminal states
 QUEUED = "queued"
@@ -397,6 +418,185 @@ def _chunk_prefill_impl(model, axes_items, params, cache, tokens, starts, true_l
 _CHUNK_SHARED = jax.jit(_chunk_prefill_impl, static_argnums=(0, 1))
 
 
+def _paged_chunk_prefill_impl(
+    model, params, cache, tokens, starts, true_lens, active, table, index_after
+):
+    """The paged twin of ``_chunk_prefill_impl`` — one [S, C] chunk for
+    every mid-prefill slot, writing through each slot's block table into
+    the page pool.
+
+    Paging makes the slab version's stash-and-restore dance unnecessary:
+    rows NOT mid-prefill are routed to the TRASH page for the duration of
+    the apply (their table rows swap to zeros), so the dispatch cannot
+    touch their K/V at all, and index leaves are overwritten wholesale
+    afterwards from ``index_after`` — the host knows every row's true
+    cursor (fill for prefilling rows, prompt + emitted for decoding rows,
+    0 for parked). ``table`` is the authoritative host mirror; the apply
+    never mutates it. The cache is deliberately NOT donated (same fault
+    isolation as the slab chunk: a fault keeps the pre-chunk pool and
+    fails only the prefilling slots)."""
+    S, C = tokens.shape
+
+    def pre(path, leaf):
+        name = _leaf_name(path)
+        if name == TABLE_LEAF:
+            routed = jnp.where(active[:, None], table, 0)
+            return jnp.broadcast_to(routed, leaf.shape).astype(leaf.dtype)
+        if name in INDEX_LEAVES:
+            return jnp.broadcast_to(starts, leaf.shape).astype(leaf.dtype)
+        return leaf
+
+    staged = jax.tree_util.tree_map_with_path(pre, cache)
+    logits, vars_out = model.apply(
+        {"params": params, "cache": staged}, tokens, mutable=["cache"]
+    )
+    new_cache = vars_out["cache"]
+
+    last = jax.vmap(
+        lambda row, i: jax.lax.dynamic_slice_in_dim(row, i, 1, axis=0)[0]
+    )(logits, jnp.clip(true_lens - 1 - starts, 0, C - 1)).astype(jnp.float32)
+
+    def post(path, leaf):
+        name = _leaf_name(path)
+        if name == TABLE_LEAF:
+            return jnp.broadcast_to(table, leaf.shape).astype(leaf.dtype)
+        if name in INDEX_LEAVES:
+            return jnp.broadcast_to(index_after, leaf.shape).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(post, new_cache), last
+
+
+_PAGED_CHUNK_SHARED = jax.jit(_paged_chunk_prefill_impl, static_argnums=(0,))
+
+
+def _spec_step_impl(
+    model, sampling, K, params, last_logits, cache, gen_mask, rngs, draft,
+    veto, active
+):
+    """Speculative fused step: sample one token per row (exactly as the
+    plain step would), then VERIFY ``K`` host-proposed draft tokens for
+    every row in the same single forward — the decode tick emits
+    ``1 + n_acc`` tokens per slot instead of 1, at one dispatch.
+
+    Acceptance per the standard draft-and-verify rule (Leviathan et al.
+    2211.17192), specialized to the deterministic (point-mass) drafts the
+    n-gram proposer emits:
+
+    - greedy: a draft survives iff it equals the model's own processed
+      argmax given the verified prefix — the emitted sequence is the plain
+      greedy sequence BY CONSTRUCTION (bit-identical; tested);
+    - sampling: draft ``d`` at position ``j`` is accepted with probability
+      ``p_j(d)`` (its probability under the processed target
+      distribution). On rejection nothing further is emitted this tick and
+      ``d`` is returned as the row's VETO: the next tick's sample masks it
+      out after processing, which is exactly the residual distribution
+      ``norm(max(p - q, 0))`` for a point-mass ``q`` — so the emitted
+      process remains distributed as plain sampling.
+
+    Carry contract: ``last_logits[s]`` is always the model's distribution
+    AFTER consuming everything row ``s`` has emitted — the accepted prefix
+    advances it K-for-free, a rejection leaves it at the rejection point.
+    The cache index rewinds in-graph to the consumed length (vector index:
+    per-row rewind is native); rows not actively decoding (``active``
+    False: parked or mid-prefill) restore their pre-tick cursor exactly.
+    Requires ``sampling.repetition_penalty == 1.0`` (enforced by the
+    engine): the penalty would make in-block positions interdependent.
+    """
+    S = last_logits.shape[0]
+    V = last_logits.shape[1]
+    split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
+    rngs, subs = split[:, 0], split[:, 1]
+    # two independent keys per row: the token sample and the K accept draws
+    sub2 = jax.vmap(jax.random.split)(subs)
+    k_tok, k_acc = sub2[:, 0], sub2[:, 1]
+
+    arangeV = jnp.arange(V)
+
+    def sample_row(key, logits_row, mask_row, veto_row):
+        # mirror of the plain step's sample_row (same [1, V] processed
+        # shapes), plus the rejection-rule veto masked AFTER processing;
+        # veto = -1 matches nothing. Greedy is veto-neutral by construction
+        # (the veto was rejected precisely because it is not the argmax).
+        proc = process_logits(logits_row[None], sampling, mask_row[None])
+        proc = jnp.where(arangeV[None, :] == veto_row, NEG_INF, proc)
+        if sampling.greedy:
+            return jnp.argmax(proc, axis=-1).astype(jnp.int32)[0]
+        return jax.random.categorical(key, proc, axis=-1).astype(jnp.int32)[0]
+
+    token = jax.vmap(sample_row)(k_tok, last_logits, gen_mask, veto)  # [S]
+    x = jnp.concatenate([token[:, None], draft], axis=1)  # [S, K+1]
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache}, x, mutable=["cache"]
+    )
+    cache = vars_out["cache"]
+    logits32 = logits.astype(jnp.float32)  # [S, K+1, V]
+
+    flat = logits32.reshape(S * (K + 1), V)
+    if sampling.greedy:
+        y = jax.vmap(
+            lambda row: jnp.argmax(
+                process_logits(row[None], sampling, None), axis=-1
+            ).astype(jnp.int32)[0]
+        )(flat).reshape(S, K + 1)
+        ok = (draft == y[:, :K]).astype(jnp.int32)
+    else:
+        p = jax.vmap(
+            lambda row: jax.nn.softmax(
+                process_logits(row[None], sampling, None), axis=-1
+            )[0]
+        )(flat).reshape(S, K + 1, V)
+        p_draft = jnp.take_along_axis(
+            p[:, :K, :], draft[..., None], axis=-1
+        )[..., 0]  # [S, K]
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, (K,)))(k_acc)
+        ok = (u < p_draft).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)  # [S] in [0, K]
+
+    rows = jnp.arange(S)
+    # distribution after the last ACCEPTED token — next tick samples from it
+    new_logits = logits32[rows, n_acc]
+    rejected = draft[rows, jnp.clip(n_acc, 0, K - 1)]
+    new_veto = jnp.where(n_acc < K, rejected, -1)
+    new_veto = jnp.where(active, new_veto, veto)
+
+    n_emit = 1 + n_acc  # token + accepted drafts
+    emitted = jnp.arange(K + 1)[None, :] < n_emit[:, None]  # [S, K+1]
+    newly = jnp.any(
+        jax.nn.one_hot(x, V, dtype=jnp.bool_) & emitted[..., None], axis=1
+    )
+    gen_mask = gen_mask | (newly & active[:, None])
+
+    # rewind: the apply advanced every index leaf by K+1; the consumed
+    # length is 1 + n_acc for decoding rows, 0 for everyone else (parked
+    # and mid-prefill rows restore their pre-tick cursor bit-exactly)
+    delta = jnp.where(active, n_emit - (K + 1), -(K + 1)).astype(jnp.int32)
+
+    def rewind(path, leaf):
+        if _leaf_name(path) in INDEX_LEAVES:
+            return leaf + delta  # [..., S] + [S]: broadcasts from the right
+        return leaf
+
+    cache = jax.tree_util.tree_map_with_path(rewind, cache)
+    # a non-finite ANYWHERE in the verify block poisons the row: drafts
+    # "validated" by garbage logits must not be emitted (the host clamps a
+    # bad row to its first token, which was sampled from the PREVIOUS
+    # finite distribution — the plain step's exact guarantee)
+    bad = nonfinite_rows(logits32)
+    return x, n_acc, new_logits, cache, gen_mask, rngs, new_veto, bad
+
+
+def _jit_spec_step():
+    return jax.jit(
+        _spec_step_impl, static_argnums=(0, 1, 2), donate_argnums=(4, 5, 6, 7, 9)
+    )
+
+
+# shared across engines like _FUSED_SHARED (statics: model, sampling, K);
+# a breaker rebuild swaps in a private instance, same as the plain step
+_SPEC_SHARED = _jit_spec_step()
+
+
 @jax.jit
 def _install_rows(last_logits, gen_mask, rngs, mask, logits_rows, keys):
     """Install every completed prefill in ONE dispatch: rows under ``mask``
@@ -444,6 +644,11 @@ class ServingEngine:
         prefill_chunk: int = 0,
         prefix_cache_chunks: int = 0,
         max_prefill_buckets: int = 8,
+        kv_layout: str = "slab",
+        page_size: int = 16,
+        page_pool_tokens: int = 0,
+        draft_k: int = 0,
+        draft_fn: Optional[Callable[[Sequence[int], int], List[int]]] = None,
     ):
         self.cfg = cfg
         self.cache_len = cache_len or cfg.max_seq_len
@@ -462,7 +667,59 @@ class ServingEngine:
         # windows; clamp so the window math never exceeds capacity
         self.prefill_chunk = min(prefill_chunk, self.cache_len)
         self.max_prefill_buckets = max_prefill_buckets
-        self.model = decode_model(cfg, self.cache_len)
+        if kv_layout not in ("slab", "paged"):
+            raise ValueError(f"kv_layout must be 'slab' or 'paged', got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        if draft_k < 0:
+            raise ValueError("draft_k must be >= 0 (0 disables speculation)")
+        if draft_k and sampling.repetition_penalty != 1.0:
+            raise ValueError(
+                "speculative serving (draft_k > 0) requires "
+                "repetition_penalty == 1.0: the penalty makes in-block "
+                "positions interdependent (one-shot generate_speculative "
+                "emulates it; the batched verify step does not)"
+            )
+        self.draft_k = int(draft_k)
+        self.draft_fn = draft_fn or ngram_propose
+        self.page_size = int(page_size)
+        if kv_layout == "paged":
+            if self.prefill_chunk == 0:
+                raise ValueError(
+                    "kv_layout='paged' requires chunked prefill "
+                    "(prefill_chunk > 0): the one-shot insert path has no "
+                    "block-table addressing"
+                )
+            if page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            if self.cache_len % page_size:
+                raise ValueError(
+                    f"page_size ({page_size}) must divide cache_len "
+                    f"({self.cache_len})"
+                )
+            if self.prefill_chunk % page_size:
+                raise ValueError(
+                    f"page_size ({page_size}) must divide prefill_chunk "
+                    f"({self.prefill_chunk}): chunk-aligned prefix sharing "
+                    "must be page-aligned so divergence starts on a page "
+                    "boundary (no live page is ever written by two rows)"
+                )
+            if page_pool_tokens == 0:
+                # slab-equivalent budget: the paged pool defaults to exactly
+                # the HBM the slab would have reserved
+                page_pool_tokens = n_slots * self.cache_len
+            if page_pool_tokens % page_size:
+                raise ValueError(
+                    f"page_pool_tokens ({page_pool_tokens}) must be a "
+                    f"multiple of page_size ({page_size})"
+                )
+            self.page_pool_tokens = int(page_pool_tokens)
+            n_pages = page_pool_tokens // page_size + 1  # + trash page
+            self.model = decode_model(
+                cfg, self.cache_len, kv_pages=(n_pages, page_size)
+            )
+        else:
+            self.page_pool_tokens = 0
+            self.model = decode_model(cfg, self.cache_len)
         self.params = params
         self.sampling = sampling
         self.eos_token_id = eos_token_id
@@ -471,22 +728,24 @@ class ServingEngine:
         self.metrics = metrics
         self.metrics_interval = metrics_interval
 
-        self.slots = SlotKVCache(self.model, n_slots, mesh=mesh)
         self.n_slots = n_slots
+        self.slots = self._make_slots()
         V = cfg.vocab_size
         self._last_logits = jnp.zeros((n_slots, V), jnp.float32)
         self._gen_mask = jnp.zeros((n_slots, V), jnp.bool_)
         self._rngs = jnp.stack([jax.random.PRNGKey(0)] * n_slots)
+        # rejection-rule carry: the draft token the verify step rejected
+        # last tick, masked out of this tick's sample (-1 = none)
+        self._veto = jnp.full((n_slots,), -1, jnp.int32)
         self._active: List[Optional[_ActiveSlot]] = [None] * n_slots
         # slot -> _PrefillJob for slots mid-chunked-prefill (acquired in the
         # SlotKVCache, not yet decoding); only the tick thread touches it
         self._prefilling: Dict[int, _PrefillJob] = {}
-        self._prefix_cache: Optional[PrefixCache] = (
-            PrefixCache(self.prefill_chunk, prefix_cache_chunks)
-            if self.prefill_chunk and prefix_cache_chunks
-            else None
-        )
+        self._prefix_cache_chunks = prefix_cache_chunks
+        self._prefix_cache: Optional[PrefixCache] = self._make_prefix_cache()
         self._chunk_fused = _CHUNK_SHARED
+        self._paged_chunk = _PAGED_CHUNK_SHARED
+        self._spec = _SPEC_SHARED
         # distinct one-shot prefill bucket lengths this engine has compiled
         # (legacy path); bounded by max_prefill_buckets + the capacity bucket
         self._buckets_seen: set = set()
@@ -555,6 +814,16 @@ class ServingEngine:
             "prefill_faults": 0,
             "prefill_bucket_capped": 0,
             "expired_prefilling": 0,
+            # paged-KV counters: allocation pressure (a page fault = the
+            # pool was empty and prefix-cache pages had to be reclaimed),
+            # and the preemption of last resort when even reclaim failed
+            "page_faults": 0,
+            "pages_reclaimed": 0,
+            "preemptions": 0,
+            # speculation counters: acceptance_rate = accepted / drafted
+            "spec_ticks": 0,
+            "draft_tokens": 0,
+            "accepted_tokens": 0,
         }
         # bounded: an unbounded all-time sample list on a long-lived server
         # is a slow memory leak AND makes every /metrics snapshot pay an
@@ -567,6 +836,36 @@ class ServingEngine:
         # prefill interference the chunk budget exists to bound
         self._itl_decode: deque = deque(maxlen=10_000)
         self._started = self.now()
+
+    # ----------------------------------------------------- device-state build
+
+    def _make_slots(self):
+        """The KV manager for the configured layout (also the rebuild path:
+        a fresh instance means a fresh pool + allocator, nothing reused)."""
+        if self.kv_layout == "paged":
+            return PagedKVCache(self.model, self.n_slots, mesh=self.mesh)
+        return SlotKVCache(self.model, self.n_slots, mesh=self.mesh)
+
+    def _make_prefix_cache(self) -> Optional[PrefixCache]:
+        if not (self.prefill_chunk and self._prefix_cache_chunks):
+            return None
+        if self.kv_layout == "paged":
+            # page-id entries refcounted against THIS pool instance — must
+            # be rebuilt whenever the pool is (reload keeps the pool and
+            # only flushes)
+            return PagedPrefixIndex(
+                self.prefill_chunk, self._prefix_cache_chunks, self.slots.pool
+            )
+        return PrefixCache(self.prefill_chunk, self._prefix_cache_chunks)
+
+    def _total_need_tokens(self, request: Request) -> int:
+        """Worst-case cache positions the request can ever write: prompt +
+        budget, plus the draft window when speculating (the verify forward
+        writes K draft positions past the cursor before the rewind)."""
+        return min(
+            len(request.prompt) + request.max_new_tokens + self.draft_k,
+            self.cache_len,
+        )
 
     # ------------------------------------------------------------- admission
 
@@ -582,6 +881,30 @@ class ServingEngine:
             return (
                 f"prompt ({T}) + max_new_tokens ({request.max_new_tokens}) "
                 f"exceeds cache_len ({self.cache_len})"
+            )
+        if self.kv_layout == "paged" and self.slots.blocks_for(
+            self._total_need_tokens(request)
+        ) > self.slots.n_pages - 1:
+            # bigger than the ENTIRE pool: admission's capacity check could
+            # never pass, and a FIFO queue would stall behind it forever —
+            # reject at submit instead
+            return (
+                f"prompt ({T}) + max_new_tokens ({request.max_new_tokens}) "
+                f"needs more KV pages than the whole pool holds "
+                f"({self.page_pool_tokens} token positions); raise "
+                f"--page-pool-tokens or lower the request"
+            )
+        if (
+            self.draft_k
+            and T + request.max_new_tokens + self.draft_k > self.cache_len
+        ):
+            # the verify forward writes K positions past the final cursor
+            # before rewinding (mirrors generate_speculative's bound); a
+            # clamped write would silently corrupt the row's tail instead
+            return (
+                f"prompt ({T}) + max_new_tokens ({request.max_new_tokens}) "
+                f"+ draft_k ({self.draft_k}) exceeds cache_len "
+                f"({self.cache_len}); lower one of them"
             )
         if (
             self.cfg.position == "learned"
@@ -763,25 +1086,50 @@ class ServingEngine:
 
     def _admit_chunked(self) -> None:
         """Claim a slot per admissible queued request and start its chunked
-        prefill. Prefix-cache hits copy their chunk-aligned K/V spans into
-        the slot's rows here, so the chunk loop starts at the first NOVEL
-        chunk; the chunk forwards themselves happen in ``_prefill_tick``,
-        shared across every mid-prefill slot — admission of N requests is
-        one batch, not N prefills."""
+        prefill. Prefix-cache hits land here: the slab path copies the
+        cached chunk-aligned K/V spans into the slot's rows; the PAGED path
+        just maps the cached pages into the slot's block table (refcount
+        bumps — zero K/V bytes move). Either way the chunk loop starts at
+        the first NOVEL chunk, and the chunk forwards themselves happen in
+        ``_prefill_tick``, shared across every mid-prefill slot — admission
+        of N requests is one batch, not N prefills.
+
+        Paged admission is CAPACITY-CHECKED: the request's worst case
+        (prompt + budget + draft headroom, minus whatever the hit covers)
+        is reserved in the page pool up front, so an admitted stream can
+        never hit a mid-decode out-of-pages fault — when the pool can't
+        cover it (even after reclaiming cold prefix-cache pages), the
+        request WAITS at the queue head instead. That waiting is the
+        capacity signal the loadgen sweep measures."""
+        paged = self.kv_layout == "paged"
         while self.slots.free_count:
             handle = self._pop_queue()
             if handle is None:
+                return
+            if paged and not self._paged_admission_fits(handle):
+                # back at the HEAD: admission stays FIFO, and the next
+                # retirement frees the pages this request is waiting for
+                with self._lock:
+                    self._queue.appendleft(handle)
                 return
             slot = self.slots.acquire()
             fill = 0
             try:
                 if self._prefix_cache is not None:
-                    fill, spans = self._prefix_cache.lookup(handle.request.prompt)
-                    if spans:
+                    fill, hits = self._prefix_cache.lookup(handle.request.prompt)
+                    if hits and paged:
+                        self.slots.share(
+                            slot, [p for entry in hits for p in entry]
+                        )
+                    elif hits:
                         # all hit chunks land in one dispatch — a deep hit
                         # must not cost one dispatch per chunk it skipped
-                        self.slots.write_spans(spans, slot)
+                        self.slots.write_spans(hits, slot)
                         self._prefill_work = True
+                if paged:
+                    self.slots.reserve(
+                        slot, self._total_need_tokens(handle.request)
+                    )
             except Exception as exc:
                 # the popped handle is in neither the queue nor any slot
                 # table yet, so _abort() cannot reach it — finish it HERE
@@ -793,6 +1141,29 @@ class ServingEngine:
             handle.admitted_at = self.now()
             handle.status = RUNNING
             self._prefilling[slot] = _PrefillJob(handle, fill=fill)
+
+    def _paged_admission_fits(self, handle: RequestHandle) -> bool:
+        """True when the page pool can cover the request's reservation
+        (after the prefix hit it is about to take). A shortfall first
+        reclaims cold prefix-cache pages (a PAGE FAULT — counted), then
+        gives up and lets the request wait."""
+        need_total = self.slots.blocks_for(self._total_need_tokens(handle.request))
+        for attempt in (0, 1):
+            hit_blocks = 0
+            if self._prefix_cache is not None:
+                fill, _ = self._prefix_cache.walk(handle.request.prompt)
+                hit_blocks = fill // self.page_size
+            shortfall = (need_total - hit_blocks) - self.slots.pool.available
+            if shortfall <= 0:
+                return True
+            if attempt or self._prefix_cache is None or not len(self._prefix_cache):
+                return False
+            # reclaim may evict the very entries the hit would have used —
+            # the re-walk above recomputes the hit honestly on retry
+            self.stats["page_faults"] += 1
+            freed = self._prefix_cache.reclaim(shortfall)
+            self.stats["pages_reclaimed"] += freed
+        return False
 
     def _admit_oneshot(self) -> None:
         """Legacy one-shot path (``prefill_chunk=0``): per-request bucketed
@@ -855,6 +1226,8 @@ class ServingEngine:
             jnp.stack(rows),
             jnp.stack(keys),
         )
+        if self.draft_k:
+            self._veto = jnp.where(jnp.asarray(mask, jnp.bool_), -1, self._veto)
 
     # ------------------------------------------------------- chunked prefill
 
@@ -870,35 +1243,82 @@ class ServingEngine:
             return False
         self._prefill_work = True
         C, L, S = self.prefill_chunk, self.cache_len, self.n_slots
+        paged = self.kv_layout == "paged"
         tokens = [[0] * C for _ in range(S)]
         starts = [0] * S
         lens = [0] * S
         active = [False] * S
+        faulted: List[int] = []
         for slot, job in self._prefilling.items():
             prompt = job.handle.request.prompt
             # clamp the window to capacity: the final chunk of a prompt
             # ending near the cap re-sends a few earlier tokens (their K/V
             # recompute bit-identically — the forward is deterministic)
-            # instead of letting the device write clamp out of alignment
+            # instead of letting the device write clamp out of alignment.
+            # (Paged: the re-sent overlap may rewrite SHARED pages — with
+            # bit-identical values, by the same determinism argument, so no
+            # copy-on-write is spent on it.)
             w = min(job.fill, L - C)
+            # pages cover only REAL prompt positions: the window's padded
+            # tail past len(prompt) routes to the trash page (unallocated
+            # blocks map there), and ensuring w + C would draw pages beyond
+            # the slot's admission reservation — stealing from already-
+            # admitted neighbors and breaking the no-mid-flight-fault
+            # invariant
+            if paged and not self._ensure_pages_or_reclaim(
+                slot, min(w + C, len(prompt))
+            ):
+                faulted.append(slot)
+                continue
             window = prompt[w : w + C]
             tokens[slot][: len(window)] = [int(t) for t in window]
             starts[slot], lens[slot], active[slot] = w, len(prompt), True
+        if faulted:
+            # reservation-backed allocation makes this unreachable unless
+            # bookkeeping rots; fail ONLY the starved jobs, loudly
+            now = self.now()
+            for slot in faulted:
+                job = self._prefilling.pop(slot)
+                self.stats["preemptions"] += 1
+                job.handle._finish(
+                    FAILED, now,
+                    error="KV page pool exhausted during prefill (retryable)",
+                    retryable=True,
+                )
+            self.slots.release(faulted)
+            self._event("page_preemption", slots=len(faulted), phase="prefill")
+            if not self._prefilling:
+                return True
         try:
             if self._chaos is not None:
                 self._chaos.on_prefill_chunk(self._tick)
-            cache, last = _in_mesh(
-                self.mesh,
-                self._chunk_fused,
-                self.model,
-                self.slots.axes_items,
-                self.params,
-                self.slots.cache,
-                jnp.asarray(tokens, jnp.int32),
-                jnp.asarray(starts, jnp.int32),
-                jnp.asarray(lens, jnp.int32),
-                jnp.asarray(active, jnp.bool_),
-            )
+            if paged:
+                cache, last = _in_mesh(
+                    self.mesh,
+                    self._paged_chunk,
+                    self.model,
+                    self.params,
+                    self.slots.cache,
+                    jnp.asarray(tokens, jnp.int32),
+                    jnp.asarray(starts, jnp.int32),
+                    jnp.asarray(lens, jnp.int32),
+                    jnp.asarray(active, jnp.bool_),
+                    jnp.asarray(self.slots.table),
+                    jnp.asarray(self._index_after(starts, lens, active), jnp.int32),
+                )
+            else:
+                cache, last = _in_mesh(
+                    self.mesh,
+                    self._chunk_fused,
+                    self.model,
+                    self.slots.axes_items,
+                    self.params,
+                    self.slots.cache,
+                    jnp.asarray(tokens, jnp.int32),
+                    jnp.asarray(starts, jnp.int32),
+                    jnp.asarray(lens, jnp.int32),
+                    jnp.asarray(active, jnp.bool_),
+                )
         except Exception as exc:
             self._on_prefill_fault(exc)
             return True
@@ -912,6 +1332,38 @@ class ServingEngine:
         if completed:
             self._install_completed(completed, last)
         return True
+
+    def _index_after(self, starts, lens, active) -> List[int]:
+        """Every row's true post-chunk cursor, host-derived (the paged
+        chunk program overwrites index leaves wholesale instead of the slab
+        path's stash-and-restore): mid-prefill rows advance their fill,
+        decoding rows sit at prompt + emitted, parked rows at zero."""
+        out = [0] * self.n_slots
+        C = self.prefill_chunk
+        for slot in range(self.n_slots):
+            if active[slot]:
+                out[slot] = min(starts[slot] + C, lens[slot])
+            elif self._active[slot] is not None:
+                act = self._active[slot]
+                out[slot] = len(act.handle.request.prompt) + act.emitted
+        return out
+
+    def _ensure_pages_or_reclaim(self, slot: int, tokens: int) -> bool:
+        """Grow ``slot``'s block table to cover ``tokens`` positions;
+        on pool exhaustion reclaim cold prefix-cache pages (page fault)
+        and retry once. Reservations make failure a bookkeeping bug, but
+        the path stays defensive rather than trusting the proof."""
+        tokens = min(tokens, self.cache_len)
+        if self.slots.ensure(slot, tokens):
+            return True
+        self.stats["page_faults"] += 1
+        if self._prefix_cache is not None and len(self._prefix_cache):
+            need = self.slots.blocks_for(tokens) - self.slots.alloc_blocks[slot]
+            freed = self._prefix_cache.reclaim(need)
+            self.stats["pages_reclaimed"] += freed
+            if self.slots.ensure(slot, tokens):
+                return True
+        return False
 
     def _install_completed(self, completed, last_rows) -> None:
         """Move slots whose prefill just finished into the decode set (one
@@ -933,6 +1385,11 @@ class ServingEngine:
             last_rows,
             jnp.stack(keys),
         )
+        if self.draft_k:
+            # fresh request, fresh rejection-rule carry
+            self._veto = jnp.where(
+                jnp.asarray(mask, jnp.bool_), -1, self._veto
+            )
         for slot, job in completed:
             del self._prefilling[slot]
             self._active[slot] = _ActiveSlot(job.handle)
@@ -941,11 +1398,13 @@ class ServingEngine:
             )
             if self._prefix_cache is not None:
                 # store BEFORE the first decode write: positions [0, T) are
-                # all real prompt K/V right now. One extraction dispatch
-                # covers every chunk-aligned span (the per-chunk version
-                # put n_chunks dispatches on the cold request's
-                # admission->first-token path); skipped entirely when the
-                # cache already holds the full aligned prefix.
+                # all real prompt K/V right now. Slab: one extraction
+                # dispatch covers every chunk-aligned span (the per-chunk
+                # version put n_chunks dispatches on the cold request's
+                # admission->first-token path). Paged: banking is PURE
+                # BOOKKEEPING — the slot's pages get one more reference and
+                # their ids land in the index; no bytes move. Skipped
+                # entirely when the cache already holds the full prefix.
                 prompt = job.handle.request.prompt
                 C = self.prefill_chunk
                 n_chunks = len(prompt) // C
@@ -953,9 +1412,17 @@ class ServingEngine:
                     self._prefix_cache.contains(prompt, j)
                     for j in range(1, n_chunks + 1)
                 ):
-                    spans = self.slots.extract_spans(slot, C, n_chunks)
-                    for j, span in enumerate(spans, start=1):
-                        self._prefix_cache.store(prompt, j, span)
+                    if self.kv_layout == "paged":
+                        bpc = C // self.page_size  # blocks per chunk
+                        pages = self.slots.bank(slot, n_chunks * bpc)
+                        for j in range(1, n_chunks + 1):
+                            self._prefix_cache.store_pages(
+                                prompt, j, pages[(j - 1) * bpc : j * bpc]
+                            )
+                    else:
+                        spans = self.slots.extract_spans(slot, C, n_chunks)
+                        for j, span in enumerate(spans, start=1):
+                            self._prefix_cache.store(prompt, j, span)
 
     def _on_prefill_fault(self, exc: Exception) -> None:
         """A chunk-prefill dispatch failed: fail ONLY the slots mid-prefill
@@ -1057,6 +1524,8 @@ class ServingEngine:
         self._prefill_work = False
         self._admit()
         ran_prefill = self._prefill_tick() if self.prefill_chunk else False
+        if self.kv_layout == "paged":
+            self._grow_decode_pages()
         # an idle DEGRADED engine still runs the fused step as a self-probe
         # (all rows parked, outputs discarded): without it, a load balancer
         # honoring the 503 starves the engine of the clean tick it needs to
@@ -1076,28 +1545,37 @@ class ServingEngine:
         try:
             if self._chaos is not None:
                 self._chaos.on_tick(self._tick)
-            token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, bad = _in_mesh(
-                self.mesh,
-                self._fused,
-                self.model,
-                self.sampling,
-                self.params,
-                self._last_logits,
-                self.slots.cache,
-                self._gen_mask,
-                self._rngs,
-            )
-            if self._chaos is not None:
-                # injected NaNs land AFTER the step, so re-run the same
-                # predicate over the poisoned logits — injected and organic
-                # NaNs are judged by the identical criterion (the extra
-                # dispatch is chaos-only; the healthy path stays at one)
-                self._last_logits = self._chaos.poison_logits(
-                    self._tick, self._last_logits
+            if self.kv_layout == "paged":
+                # one batched push of every block-table change this tick
+                # (admissions, growth, retirements) before the fused step
+                # reads the device tables
+                self.slots.flush_tables()
+            if self.draft_k:
+                blocks, n_emits, bad_rows = self._dispatch_spec()
+            else:
+                token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, bad = _in_mesh(
+                    self.mesh,
+                    self._fused,
+                    self.model,
+                    self.sampling,
+                    self.params,
+                    self._last_logits,
+                    self.slots.cache,
+                    self._gen_mask,
+                    self._rngs,
                 )
-                bad = _in_mesh(self.mesh, nonfinite_rows, self._last_logits)
-            tokens, bad_rows = jax.device_get((token, bad))
-            tokens = tokens.tolist()
+                if self._chaos is not None:
+                    # injected NaNs land AFTER the step, so re-run the same
+                    # predicate over the poisoned logits — injected and organic
+                    # NaNs are judged by the identical criterion (the extra
+                    # dispatch is chaos-only; the healthy path stays at one)
+                    self._last_logits = self._chaos.poison_logits(
+                        self._tick, self._last_logits
+                    )
+                    bad = _in_mesh(self.mesh, nonfinite_rows, self._last_logits)
+                tokens, bad_rows = jax.device_get((token, bad))
+                blocks = [[int(t)] for t in tokens.tolist()]
+                n_emits = [1] * self.n_slots
         except Exception as exc:
             self._on_tick_fault(exc)
             self._tick += 1
@@ -1116,28 +1594,40 @@ class ServingEngine:
         for slot, act in enumerate(self._active):
             if act is None:
                 continue
-            t = tokens[slot]
+            toks = blocks[slot][: n_emits[slot]]
             if act.emitted == 0:
                 ttft_new.append(now - act.handle.submitted_at)
             elif act.last_emit_at is not None:
-                itl_new.append(now - act.last_emit_at)
-            # this tick's token was sampled from the PREVIOUS (finite)
+                # a speculative tick delivers its accepted block in one
+                # burst; one AMORTIZED sample per token keeps the ITL
+                # percentiles honest about per-token latency (n_emit = 1
+                # degenerates to the classic one-sample-per-tick)
+                gap = now - act.last_emit_at
+                itl_new.extend([gap / len(toks)] * len(toks))
+            # the block's first token was sampled from the PREVIOUS (finite)
             # logits, so it is valid even when the new logits went bad —
             # emit it, then retire the poisoned slot with a retryable error
-            act.handle._emit(t, now)
-            act.emitted += 1
-            act.last_emit_at = now
-            self.stats["tokens_out"] += 1
-            hit_eos = self.eos_token_id is not None and t == self.eos_token_id
-            if hit_eos or act.emitted >= act.handle.request.max_new_tokens:
-                # completion outranks the poison flag: this tick's token came
-                # from the PREVIOUS finite logits, so a request finishing now
-                # delivered a fully valid output — the bad NEW logits would
-                # never have been sampled from
-                act.handle._finish(DONE, now)
-                self.stats["completed"] += 1
-                finished.append(slot)
-            elif bool(bad_rows[slot]):
+            # (a bad row's n_emit is already clamped to that first token:
+            # drafts "verified" by garbage logits are never emitted)
+            done_now = False
+            for t in toks:
+                act.handle._emit(int(t), now)
+                act.emitted += 1
+                act.last_emit_at = now
+                self.stats["tokens_out"] += 1
+                hit_eos = (
+                    self.eos_token_id is not None and int(t) == self.eos_token_id
+                )
+                if hit_eos or act.emitted >= act.handle.request.max_new_tokens:
+                    # completion outranks the poison flag: the tokens
+                    # emitted so far all trace to finite logits, so a
+                    # request finishing now delivered a fully valid output
+                    act.handle._finish(DONE, now)
+                    self.stats["completed"] += 1
+                    finished.append(slot)
+                    done_now = True
+                    break
+            if not done_now and bool(bad_rows[slot]):
                 act.handle._finish(
                     FAILED, now,
                     error="non-finite logits in decode (retryable)",
@@ -1178,6 +1668,93 @@ class ServingEngine:
         ):
             self.metrics.log(self.metrics_snapshot(), step=self._tick, prefix="serve")
         return not probe
+
+    # --------------------------------------------------- speculative decode
+
+    def _dispatch_spec(self):
+        """Run the speculative fused step for this tick: host-propose K
+        draft tokens per decoding slot (prompt-lookup over the slot's own
+        prompt + emitted history, or the engine's pluggable ``draft_fn``),
+        verify them all in ONE batched forward, and return per-slot emit
+        blocks. A row whose verify logits went non-finite is clamped to its
+        first token (sampled from the previous, finite distribution) — the
+        plain step's exact poison semantics."""
+        K, S = self.draft_k, self.n_slots
+        V = self.cfg.vocab_size
+        drafts = [[0] * K for _ in range(S)]
+        active = [a is not None for a in self._active]
+        for slot, act in enumerate(self._active):
+            if act is None:
+                continue
+            hist = list(act.handle.request.prompt) + act.handle.tokens
+            d = [int(t) for t in self.draft_fn(hist, K)]
+            # clamp a misbehaving custom draft_fn: wrong-length or
+            # out-of-vocab drafts must degrade acceptance, not crash a tick
+            drafts[slot] = [t % V for t in d[:K]] + [0] * (K - len(d))
+        x, n_acc, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, self._veto, bad = _in_mesh(
+            self.mesh,
+            self._spec,
+            self.model,
+            self.sampling,
+            K,
+            self.params,
+            self._last_logits,
+            self.slots.cache,
+            self._gen_mask,
+            self._rngs,
+            jnp.asarray(drafts, jnp.int32),
+            self._veto,
+            jnp.asarray(active, jnp.bool_),
+        )
+        if self._chaos is not None:
+            self._last_logits = self._chaos.poison_logits(
+                self._tick, self._last_logits
+            )
+            bad = bad | _in_mesh(self.mesh, nonfinite_rows, self._last_logits)
+        xs, n_accs, bad_rows = jax.device_get((x, n_acc, bad))
+        self.stats["spec_ticks"] += 1
+        blocks = [row.tolist() for row in xs]
+        n_emits = [1] * S
+        for slot in range(S):
+            if not active[slot]:
+                continue
+            self.stats["draft_tokens"] += K
+            if not bool(bad_rows[slot]):
+                acc = int(n_accs[slot])
+                self.stats["accepted_tokens"] += acc
+                n_emits[slot] = 1 + acc
+        return blocks, n_emits, bad_rows
+
+    def _grow_decode_pages(self) -> None:
+        """Paged: extend each decoding slot's block table to cover this
+        tick's writes (cursor + 1, plus the draft window when speculating),
+        with a copy-on-write guard on the first written block (chunk/page
+        alignment makes a shared cursor page unreachable; the guard keeps
+        that a checked invariant). A slot the pool genuinely cannot cover —
+        reservations make that a bookkeeping bug — preempts retryably
+        rather than corrupting a neighbor."""
+        span = 1 + self.draft_k
+        victims: List[int] = []
+        for slot, act in enumerate(self._active):
+            if act is None:
+                continue
+            cursor = len(act.handle.request.prompt) + act.emitted
+            if not self._ensure_pages_or_reclaim(slot, cursor + span):
+                victims.append(slot)
+                continue
+            if not self.slots.cow(slot, cursor // self.page_size):
+                victims.append(slot)
+        if victims:
+            now = self.now()
+            for slot in victims:
+                self.stats["preemptions"] += 1
+                self._active[slot].handle._finish(
+                    FAILED, now,
+                    error="KV page pool exhausted; request preempted (retryable)",
+                    retryable=True,
+                )
+            self._retire(victims)
+            self._event("page_preemption", slots=len(victims), phase="decode")
 
     # ------------------------------------------------------ tick supervision
 
@@ -1241,8 +1818,10 @@ class ServingEngine:
             )
             self._event("breaker_trip", trips=self.stats["breaker_trips"])
             # the executable itself is suspect only once faults PERSIST:
-            # swap in a privately jitted step on each trip
+            # swap in a privately jitted step on each trip (the spec step
+            # is the same executable family — swap it with its twin)
             self._fused = _jit_fused_step()
+            self._spec = _jit_spec_step()
         # device buffers are suspect after EVERY fused-call fault, threshold
         # or not: the step donates logits/cache/masks/rngs, so an exception
         # after dispatch leaves them deleted or half-written — reusing them
@@ -1253,21 +1832,28 @@ class ServingEngine:
     def _rebuild_device_state(self) -> None:
         """Reallocate every device buffer the tick thread owns; nothing from
         a suspect tick is reused. Host state (queue, stats, lifecycle) and
-        params are untouched."""
-        self.slots = SlotKVCache(self.model, self.n_slots, mesh=self.mesh)
+        params are untouched. Paged: a fresh ``PagedKVCache`` means a fresh
+        page pool AND a fresh allocator/refcount state — the pool
+        reinitializes wholesale, never patched."""
+        self.slots = self._make_slots()
         V = self.cfg.vocab_size
         self._last_logits = jnp.zeros((self.n_slots, V), jnp.float32)
         self._gen_mask = jnp.zeros((self.n_slots, V), jnp.bool_)
         self._rngs = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
+        self._veto = jnp.full((self.n_slots,), -1, jnp.int32)
         self._active = [None] * self.n_slots
         self._prefilling.clear()
         self._prefill_cache = None  # legacy template reallocates lazily
         if self._prefix_cache is not None:
-            # conservative: cached spans were extracted from earlier, clean
-            # ticks and are independent buffers, but re-deriving which
-            # survived a faulted tick is not worth wrong K/V if the
-            # reasoning ever rots — cold misses rebuild the cache
-            self._prefix_cache.flush()
+            # conservative: cached entries trace to earlier, clean ticks,
+            # but re-deriving which survived a faulted tick is not worth
+            # wrong K/V if the reasoning ever rots — cold misses rebuild
+            # the cache. Paged: the old index refcounts into the DEAD pool;
+            # rebuild it against the fresh one instead of flushing into it.
+            if self.kv_layout == "paged":
+                self._prefix_cache = self._make_prefix_cache()
+            else:
+                self._prefix_cache.flush()
         self._event("engine_rebuilt")
 
     # ----------------------------------------------------------------- drain
@@ -1434,9 +2020,18 @@ class ServingEngine:
         # generate() under the NEW weights exactly. (Decoding slots keep
         # the PR 3 contract: they continue on the new weights from their
         # next token, nothing retires.)
-        for job in self._prefilling.values():
+        for slot, job in self._prefilling.items():
             job.fill = 0
             job.handle.prefix_hit_tokens = 0
+            if self.kv_layout == "paged":
+                # the slot may map SHARED pages from its pre-reload prefix
+                # hit; re-prefilling under the new weights must not write
+                # into pages other slots still read — drop every page and
+                # refill fresh (the full worst case re-reserves)
+                self.slots.reset_slot_pages(slot)
+                self.slots.reserve(
+                    slot, self._total_need_tokens(job.handle.request)
+                )
         swap_event.set()
         self._event("reload_swapped", reloads=self.stats["reloads"])
 
@@ -1516,6 +2111,24 @@ class ServingEngine:
             "prefill_chunk": self.prefill_chunk,
             "prefilling": len(self._prefilling),
             "prefill_buckets": len(self._buckets_seen),
+            # paged-KV + speculation gauges (zeros when the feature is off,
+            # so dashboards and the bench schema stay layout-agnostic)
+            "kv_layout": self.kv_layout,
+            "draft_k": self.draft_k,
+            "page_pool_util": (
+                self.slots.page_pool_util if self.kv_layout == "paged" else 0.0
+            ),
+            "page_pool_peak": (
+                self.slots.pool.peak_in_use if self.kv_layout == "paged" else 0
+            ),
+            "cow_copies": (
+                self.slots.cow_copies if self.kv_layout == "paged" else 0
+            ),
+            "acceptance_rate": (
+                self.stats["accepted_tokens"] / self.stats["draft_tokens"]
+                if self.stats["draft_tokens"]
+                else 0.0
+            ),
         }
         if self._prefix_cache is not None:
             snap.update(self._prefix_cache.stats())
@@ -1541,6 +2154,8 @@ class ServingEngine:
             "rejected_draining", "drain_forced", "reloads", "reloads_rejected",
             "prefill_chunks", "prefill_faults", "prefill_bucket_capped",
             "expired_prefilling",
+            "page_faults", "pages_reclaimed", "preemptions",
+            "spec_ticks", "draft_tokens", "accepted_tokens",
         ):
             snap[k] = self.stats[k]
         return snap

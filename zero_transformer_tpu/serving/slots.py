@@ -19,7 +19,9 @@ scalars — so admissions, retirements, and occupancy changes never recompile.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +32,11 @@ from zero_transformer_tpu.inference.generate import init_cache
 # (cache_index: per-layer attention write position; decode_pos: the learned-
 # position table offset at the Transformer level.)
 INDEX_LEAVES = ("cache_index", "decode_pos")
+
+# K/V byte-holding leaves of the PAGED cache ([n_pages, page, ...] pools);
+# the int32 per-row page map is its own leaf
+POOL_LEAVES = ("cached_key", "cached_value", "key_scale", "value_scale")
+TABLE_LEAF = "block_table"
 
 
 def _leaf_name(path) -> str:
@@ -298,6 +305,304 @@ class SlotKVCache:
         for s in slots:
             if s in self._free:
                 raise ValueError(f"slot {s} double-released")
+            self._free.append(s)
+        keep = jnp.asarray(
+            [s not in self._free for s in range(self.n_slots)], jnp.bool_
+        )
+        self.cache = _reset_index(self.cache, keep)
+
+
+# ---- paged KV cache (block tables over a global page pool) -----------------
+#
+# The slab above reserves n_slots * cache_len positions of K/V whatever the
+# actual sequence lengths; the paged layout below reserves only the pages a
+# sequence really fills (PagedAttention, Kwon et al. 2309.06180). Pages are
+# REFCOUNTED: a slot mapping a page holds one reference and the paged prefix
+# index holds another per cached chunk, so a prefix hit is a refcount bump
+# into the new slot's block table — zero K/V bytes move — and nothing frees
+# a page while any live slot or cached prefix still maps it.
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_page(cache: Any, src: jax.Array, dst: jax.Array) -> Any:
+    """Copy pool page ``src`` onto ``dst`` in every K/V pool leaf, one
+    dispatch — the copy-on-write primitive. The page axis sits at
+    ``ndim - 4`` in every pool layout this repo produces (per-layer
+    [n_pages, page, KVH, D|1], scanned [L, n_pages, page, KVH, D|1])."""
+
+    def one(path, leaf):
+        if _leaf_name(path) not in POOL_LEAVES:
+            return leaf
+        ax = leaf.ndim - 4
+        row = jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=ax)
+        return jax.lax.dynamic_update_slice_in_dim(leaf, row, dst, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(one, cache)
+
+
+class PagePool:
+    """Host-side page allocator: free list + per-page refcounts.
+
+    Page 0 is the TRASH page — never allocated, always mapped by zeroed
+    block-table rows, so parked/inactive rows in a fixed-shape dispatch
+    write somewhere harmless (their reads are masked by validity anyway).
+
+    ``reserved`` tracks pages PROMISED to admitted slots but not yet drawn:
+    admission reserves a request's worst case (prompt + budget + draft
+    headroom) up front, so a slot that was admitted can never hit a
+    mid-decode out-of-pages fault — capacity pressure surfaces as requests
+    WAITING in the queue, the honest backpressure signal the capacity sweep
+    measures.
+    """
+
+    TRASH = 0
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError("n_pages must be >= 2 (trash + at least one real)")
+        self.n_pages = n_pages
+        self.refs = [0] * n_pages
+        self._free: List[int] = list(range(1, n_pages))
+        self.reserved = 0
+        self.peak_in_use = 0
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return (self.n_pages - 1) - len(self._free)
+
+    @property
+    def available(self) -> int:
+        """Pages neither allocated nor promised to an admitted slot."""
+        return len(self._free) - self.reserved
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self.refs[page] = 1
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
+        return page
+
+    def incref(self, pages) -> None:
+        for p in pages:
+            if p == self.TRASH or self.refs[p] < 1:
+                raise ValueError(f"incref of unallocated page {p}")
+            self.refs[p] += 1
+
+    def decref(self, pages) -> int:
+        """Drop one reference per page; pages reaching zero return to the
+        free list. Returns how many were actually freed."""
+        freed = 0
+        for p in pages:
+            if p == self.TRASH:
+                continue
+            if self.refs[p] < 1:
+                raise ValueError(f"decref of free page {p}")
+            self.refs[p] -= 1
+            if self.refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
+
+class PagedKVCache:
+    """Paged drop-in for ``SlotKVCache``: same slot bookkeeping surface
+    (acquire / release / free_count / insert-less chunked fill), but K/V
+    lives in the model's page pool and each slot's rows are a block table.
+
+    Device state: ``self.cache`` (pool leaves + ``block_table`` + vector
+    index leaves). Host state: the authoritative block-table mirror
+    (``self.table``), per-slot allocation/reservation counts, and the
+    ``PagePool``. Only the engine's tick thread touches any of it.
+    """
+
+    def __init__(self, model, n_slots: int, mesh=None):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if model.kv_pages is None:
+            raise ValueError("PagedKVCache needs a paged decode model (kv_pages)")
+        self.model = model
+        self.n_slots = n_slots
+        self.mesh = mesh
+        self.n_pages, self.page_size = model.kv_pages
+        cap = model.cache_len or model.cfg.max_seq_len
+        self.seq_capacity = cap
+        self.n_blocks = cap // self.page_size
+        self.pool = PagePool(self.n_pages)
+        # host mirror of every row's block table; zeros = trash page
+        self.table = np.zeros((n_slots, self.n_blocks), np.int32)
+        # mapping changed since the last device push (mutators mark, the
+        # engine flushes ONCE before any dispatch that reads device tables)
+        self.tables_dirty = False
+        self.alloc_blocks = [0] * n_slots  # leading blocks mapped, per slot
+        self.reserved_blocks = [0] * n_slots  # admission promise, per slot
+        self.cache = vectorize_index(
+            init_cache(model, n_slots, mesh=mesh), n_slots
+        )
+        self._free: List[int] = list(range(n_slots))
+        self.cow_copies = 0
+
+    # ---- device sync -----------------------------------------------------
+
+    def sync_tables(self) -> None:
+        """Push the host block-table mirror into every ``block_table`` leaf
+        (per-layer copies under the scanned stack broadcast the same
+        values). Tiny int32 traffic; ``flush_tables`` below batches the
+        pushes to one per tick."""
+        dev = jnp.asarray(self.table)
+
+        def one(path, leaf):
+            if _leaf_name(path) == TABLE_LEAF:
+                return jnp.broadcast_to(dev, leaf.shape).astype(leaf.dtype)
+            return leaf
+
+        self.cache = jax.tree_util.tree_map_with_path(one, self.cache)
+        self.tables_dirty = False
+
+    def flush_tables(self) -> None:
+        """One device push for every mapping change since the last flush.
+        MUST run before any dispatch that reads the device tables (the
+        fused decode / spec step); the paged chunk program is exempt — it
+        takes the host table as an argument and overwrites the device
+        leaves itself. Batching matters: N slots crossing a page boundary
+        on one tick would otherwise pay N separate pushes on the decode
+        hot path."""
+        if self.tables_dirty:
+            self.sync_tables()
+
+    # ---- allocation ------------------------------------------------------
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)  # ceil
+
+    def can_admit(self, new_blocks: int) -> bool:
+        return self.pool.available >= new_blocks
+
+    def reserve(self, slot: int, total_tokens: int) -> None:
+        """Promise pages covering ``total_tokens`` logical positions beyond
+        what the slot already maps (shared prefix pages included in
+        ``alloc_blocks`` by ``share``). Re-reserving replaces the slot's
+        previous promise."""
+        self._unreserve(slot)
+        need = max(0, self.blocks_for(total_tokens) - self.alloc_blocks[slot])
+        self.reserved_blocks[slot] = need
+        self.pool.reserved += need
+
+    def _unreserve(self, slot: int) -> None:
+        self.pool.reserved -= self.reserved_blocks[slot]
+        self.reserved_blocks[slot] = 0
+
+    def ensure(self, slot: int, tokens: int) -> bool:
+        """Map fresh pages so the slot's table covers positions
+        ``[0, tokens)``; draws down the slot's reservation. Returns False
+        when the pool is exhausted (the engine reclaims prefix-cache pages
+        and retries, then preempts)."""
+        need = self.blocks_for(tokens)
+        while self.alloc_blocks[slot] < need:
+            page = self.pool.alloc()
+            if page is None:
+                return False
+            b = self.alloc_blocks[slot]
+            self.table[slot, b] = page
+            self.alloc_blocks[slot] = b + 1
+            if self.reserved_blocks[slot] > 0:
+                self.reserved_blocks[slot] -= 1
+                self.pool.reserved -= 1
+            self.tables_dirty = True
+        return True
+
+    def share(self, slot: int, pages: Sequence[int]) -> None:
+        """Prefix hit: map ``pages`` as the slot's leading blocks and bump
+        their refcounts — K/V reuse without moving a byte."""
+        if not pages:
+            return
+        if self.alloc_blocks[slot] != 0:
+            raise ValueError("share() must precede any allocation for the slot")
+        self.pool.incref(pages)
+        for b, p in enumerate(pages):
+            self.table[slot, b] = p
+        self.alloc_blocks[slot] = len(pages)
+        self.tables_dirty = True
+
+    def bank(self, slot: int, n_blocks: int) -> List[int]:
+        """Page ids of the slot's first ``n_blocks`` blocks, refcounts
+        bumped for the prefix index's hold (the caller stores them)."""
+        pages = [int(p) for p in self.table[slot, :n_blocks]]
+        self.pool.incref(pages)
+        return pages
+
+    def cow(self, slot: int, block: int) -> bool:
+        """Copy-on-write guard: if the slot is about to WRITE into a shared
+        page, give it a private copy first. Chunk-aligned sharing makes
+        this unreachable in the steady state (divergence starts at a page
+        boundary), but the guard keeps 'shared pages are never written with
+        divergent data' a local invariant instead of a global proof."""
+        if block >= self.alloc_blocks[slot]:
+            return True
+        page = int(self.table[slot, block])
+        if page == PagePool.TRASH or self.pool.refs[page] <= 1:
+            return True
+        fresh = self.pool.alloc()
+        if fresh is None:
+            return False
+        self.cache = _copy_page(
+            self.cache, jnp.int32(page), jnp.int32(fresh)
+        )
+        self.table[slot, block] = fresh
+        self.pool.decref([page])
+        self.cow_copies += 1
+        self.tables_dirty = True
+        return True
+
+    def reset_slot_pages(self, slot: int) -> None:
+        """Drop every page the slot maps WITHOUT freeing the slot itself
+        (hot-reload prefill restart: shared pre-reload pages must not be
+        rewritten under new weights). The caller re-reserves."""
+        n = self.alloc_blocks[slot]
+        if not n:
+            return
+        self.pool.decref(int(p) for p in self.table[slot, :n])
+        self.table[slot, :n] = 0
+        self.alloc_blocks[slot] = 0
+        self.tables_dirty = True
+
+    # ---- slot bookkeeping (SlotKVCache-compatible surface) ---------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def page_pool_util(self) -> float:
+        real = self.n_pages - 1
+        return self.pool.in_use / real if real else 0.0
+
+    def acquire(self) -> Optional[int]:
+        return self._free.pop(0) if self._free else None
+
+    def release(self, slots: List[int]) -> None:
+        """Retire slots: drop their page references (pages a cached prefix
+        still holds survive), zero their table rows and index cursors."""
+        if not slots:
+            return
+        for s in slots:
+            if s in self._free:
+                raise ValueError(f"slot {s} double-released")
+            n = self.alloc_blocks[s]
+            if n:
+                self.pool.decref(int(p) for p in self.table[s, :n])
+                self.table[s, :n] = 0
+                self.alloc_blocks[s] = 0
+                self.tables_dirty = True
+            self._unreserve(s)
             self._free.append(s)
         keep = jnp.asarray(
             [s not in self._free for s in range(self.n_slots)], jnp.bool_
