@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full chaos elastic-chaos serve-chaos obs bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -43,6 +43,19 @@ elastic-chaos:
 serve-chaos:
 	$(PY) -m pytest tests/test_serving_resilience.py -q -m chaos $(PYTEST_ARGS)
 
+# Observability lane (ISSUE 7): the obs test file (span-tree parity over
+# every request outcome, Prometheus exposition conformance under live
+# traffic, X-Request-Id round trip, flight-recorder dump on breaker-open,
+# /admin/profile lifecycle) plus a loadgen trace smoke — one small run must
+# produce a Perfetto-loadable span trace with nonzero events.
+obs:
+	$(PY) -m pytest tests/test_obs.py -q $(PYTEST_ARGS)
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 4 --slots 2 \
+		--max-new-tokens 8 --cache-len 64 --out /tmp/_obs_smoke.json
+	$(PY) -c "import json; t=json.load(open('/tmp/_obs_smoke.trace.json')); \
+		n=len(t['traceEvents']); assert n, 'empty trace'; \
+		print(f'obs trace smoke ok: {n} events')"
+
 # One-line JSON benchmark artifact (driver contract).
 bench:
 	$(PY) bench.py
@@ -66,7 +79,7 @@ serve-bench:
 	@cp BENCH_serve.json /tmp/_serve_baseline.json 2>/dev/null || true
 	@cp BENCH_serve_capacity.json /tmp/_serve_cap_baseline.json 2>/dev/null || true
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
-		--spec-k 4 --greedy --max-new-tokens 32 --cache-len 64
+		--spec-k 4 --greedy --max-new-tokens 32 --cache-len 64 --obs-ab
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
 		--shared-prefix --cache-len 64 --out BENCH_serve_prefix.json
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --capacity-sweep \

@@ -9,6 +9,10 @@ tolerance:
 
 - ``decode_tok_s`` (aggregate decode throughput) drops > 15%
 - ``itl_ms.p99`` (tail inter-token latency) grows > 15%
+- the fresh artifact's measured span-tracing overhead (``obs_overhead``,
+  from the loadgen's --obs-ab tracing-on/off A/B on this same run's
+  hardware) exceeds 2% of decode tok/s — observability must stay
+  effectively free on the hot path
 
 "Matching hardware" is judged from the artifact's ``platform`` block (jax
 backend + device kind): a TPU box must not be graded against a CPU
@@ -26,6 +30,10 @@ import sys
 from pathlib import Path
 
 TOLERANCE = 0.15
+# span tracing must cost <= this fraction of decode tok/s (ISSUE 7): the
+# A/B inside one artifact ran both arms on the same box minutes apart, so
+# unlike the baseline comparison there is no hardware-mismatch skip
+OBS_OVERHEAD_MAX = 0.02
 
 
 def compare_capacity(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
@@ -84,6 +92,21 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
         )
     else:
         msgs.append(f"ok: itl_ms.p99 {fresh_p99:.3f} ms (baseline {base_p99:.3f} ms)")
+
+    obs = fresh.get("obs_overhead")
+    if obs and obs.get("overhead_frac", 0) > OBS_OVERHEAD_MAX:
+        ok = False
+        msgs.append(
+            f"REGRESSION: span-tracing overhead "
+            f"{obs['overhead_frac'] * 100:.1f}% of decode tok/s > "
+            f"{OBS_OVERHEAD_MAX * 100:.0f}% budget "
+            f"(on {obs.get('decode_tok_s_trace_off', 0):.1f} tok/s traced off)"
+        )
+    elif obs:
+        msgs.append(
+            f"ok: span-tracing overhead {obs['overhead_frac'] * 100:.1f}% "
+            f"(budget {OBS_OVERHEAD_MAX * 100:.0f}%)"
+        )
     return ok, msgs
 
 

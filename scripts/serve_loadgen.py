@@ -23,7 +23,12 @@ Every request's token stream is checked byte-for-byte against single-request
 ``generate()`` with the same seed (``--no-verify`` to skip): the engine's
 request-isolation invariant, measured under real contention. The run emits a
 ``BENCH_serve.json`` artifact (one JSON doc, also printed as the final
-stdout line) with TTFT/ITL percentiles, tokens/s, and occupancy evidence.
+stdout line) with TTFT/ITL percentiles, tokens/s, and occupancy evidence,
+plus a Perfetto span-trace artifact (``<out>.trace.json`` — the measured
+engine's request lifecycle trees and per-tick phase timeline). ``--obs-ab``
+additionally measures span-tracing overhead (tracing OFF vs ON, best-of-N
+per arm) into the ``obs_overhead`` field, which the bench guard holds to
+<= 2% on decode tok/s.
 
 CPU-runnable end to end with the ``test`` zoo model and random-init params —
 the orchestration layer is what is being measured, so no checkpoint needed:
@@ -103,6 +108,19 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0, help="base request seed")
     p.add_argument("--no-verify", action="store_true",
                    help="skip the per-request generate() parity check")
+    p.add_argument("--obs-ab", action="store_true",
+                   help="measure tracing overhead: run the workload with "
+                        "span tracing OFF and ON (--obs-ab-repeats each, "
+                        "best-of), and embed the A/B as obs_overhead in the "
+                        "artifact — scripts/serve_bench_guard.py fails a "
+                        "committed overhead_frac > 2%%")
+    p.add_argument("--obs-ab-repeats", type=int, default=3,
+                   help="repeats per tracing arm in the --obs-ab A/B "
+                        "(best-of-N de-noises the 2%% bar on shared boxes)")
+    p.add_argument("--trace-out", default=None,
+                   help="Perfetto/Chrome-trace artifact path for the "
+                        "measured run's span ring (default: <--out> with "
+                        "a .trace.json suffix)")
     p.add_argument("--chaos", action="store_true",
                    help="inject serving faults into the measured run (a "
                         "decode-tick fault window + a NaN-logit window): "
@@ -163,7 +181,7 @@ def build(args):
     kv_layout = args.kv_layout if args.prefill_chunk else "slab"
 
     def engine(chaos=None, prefix_cache=None, spec_k=None, slots=None,
-               layout=None, pool_tokens=None):
+               layout=None, pool_tokens=None, trace=True):
         chunks = prefix_cache if prefix_cache is not None else args.prefix_cache
         lay = layout or kv_layout
         return ServingEngine(
@@ -178,6 +196,7 @@ def build(args):
                 if lay == "paged" else 0
             ),
             draft_k=args.spec_k if spec_k is None else spec_k,
+            trace=trace,
         )
 
     return cfg, params, sampling, cache_len, engine
@@ -477,8 +496,40 @@ def main(argv=None) -> dict:
             "itl_ms_p50": round(csnap["itl_ms_p50"], 3),
         }
 
+    # tracing-overhead A/B: alternate OFF/ON arms on the same workload and
+    # take each arm's best run — the stable statistic on a noisy shared box
+    # (the guard holds the committed overhead to <=2%, far below run-to-run
+    # noise of a single sample). Runs BEFORE the measured engine, same
+    # warm-everything discipline as the other controls.
+    obs_ab = None
+    if args.obs_ab:
+        best = {"off": 0.0, "on": 0.0}
+        for _ in range(max(1, args.obs_ab_repeats)):
+            for arm in ("off", "on"):
+                e = make_engine(trace=(arm == "on"))
+                hs, w = run_load(e, requests, args)
+                toks = sum(len(h.tokens) for h in hs if h is not None)
+                best[arm] = max(best[arm], toks / w)
+        overhead = (
+            max(0.0, (best["off"] - best["on"]) / best["off"])
+            if best["off"] else 0.0
+        )
+        obs_ab = {
+            "decode_tok_s_trace_off": round(best["off"], 3),
+            "decode_tok_s_trace_on": round(best["on"], 3),
+            "overhead_frac": round(overhead, 4),
+            "repeats": max(1, args.obs_ab_repeats),
+        }
+
     engine = make_engine(chaos_plan(args) if args.chaos else None)
     handles, wall = run_load(engine, requests, args)
+    # one Perfetto trace artifact per run: the measured engine's span ring
+    # (request lifecycle trees + per-tick engine phases), loadable at
+    # ui.perfetto.dev — docs/OBSERVABILITY.md shows how to read it
+    trace_path = args.trace_out or (
+        args.out[:-5] if args.out.endswith(".json") else args.out
+    ) + ".trace.json"
+    engine.tracer.write_chrome_trace(trace_path)
 
     terminal = ("done", "cancelled", "expired", "rejected", "failed")
     # dropped = HUNG (no terminal event) — the acceptance bar's "no in-flight
@@ -551,6 +602,12 @@ def main(argv=None) -> dict:
         "acceptance_rate": round(snap["acceptance_rate"], 4),
         "spec_ticks": snap["spec_ticks"],
         "no_speculation": no_spec,
+        # observability evidence (ISSUE 7): the tracing-cost A/B (None
+        # unless --obs-ab measured it) and the Perfetto span artifact every
+        # run saves next to the JSON
+        "obs_overhead": obs_ab,
+        "trace_file": Path(trace_path).name,
+        "obs_spans": len(engine.tracer),
         "platform": {
             "backend": jax.default_backend(),
             "device": getattr(jax.devices()[0], "device_kind", "unknown"),

@@ -10,6 +10,8 @@ import importlib.util
 import json
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 REQUIRED_KEYS = {
@@ -32,6 +34,10 @@ REQUIRED_KEYS = {
     "kv_layout", "page_size", "page_faults", "pages_reclaimed",
     "preemptions", "page_pool_util", "cow_copies",
     "draft_k", "acceptance_rate", "spec_ticks", "no_speculation",
+    # observability evidence (ISSUE 7): tracing-cost A/B (populated by
+    # --obs-ab, None otherwise) and the Perfetto span artifact every run
+    # writes beside the JSON
+    "obs_overhead", "trace_file", "obs_spans",
 }
 
 CAPACITY_REQUIRED_KEYS = {
@@ -91,6 +97,13 @@ def test_loadgen_artifact_schema_and_invariants(tmp_path):
     assert artifact["kv_layout"] == "paged" and artifact["page_size"] > 0
     assert artifact["preemptions"] == 0
     assert artifact["draft_k"] == 0 and artifact["no_speculation"] is None
+    # every run writes a Perfetto-loadable span trace next to the artifact
+    assert artifact["obs_overhead"] is None  # --obs-ab not requested here
+    assert artifact["obs_spans"] > 0
+    trace = json.loads((out.parent / artifact["trace_file"]).read_text())
+    assert trace["traceEvents"], "span trace artifact is empty"
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert {"request", "queue", "prefill", "decode"} <= names, names
 
 
 def test_loadgen_speculative_run_verified_with_acceptance(tmp_path):
@@ -112,6 +125,29 @@ def test_loadgen_speculative_run_verified_with_acceptance(tmp_path):
     assert artifact["acceptance_rate"] > 0
     assert artifact["no_speculation"] is not None
     assert artifact["no_speculation"]["decode_tok_s"] > 0
+
+
+@pytest.mark.slow
+def test_loadgen_obs_ab_measures_tracing_overhead(tmp_path):
+    """--obs-ab: the tracing-on/off A/B runs both arms and embeds a sane
+    obs_overhead block (fractions in [0, 1], both arms nonzero). Slow lane:
+    the A/B is two extra full load runs; tier-1 covers the obs_overhead
+    schema key (None without --obs-ab) and the guard logic, and
+    make serve-bench runs the real best-of-5 A/B into the committed
+    BENCH_serve.json where the guard enforces the <=2% budget."""
+    loadgen = _load()
+    out = tmp_path / "BENCH_serve_obs.json"
+    artifact = loadgen.main([
+        "--requests", "4", "--slots", "2", "--concurrency", "4",
+        "--max-new-tokens", "8", "--obs-ab", "--obs-ab-repeats", "1",
+        "--out", str(out),
+    ])
+    ab = artifact["obs_overhead"]
+    assert ab is not None
+    assert ab["decode_tok_s_trace_off"] > 0
+    assert ab["decode_tok_s_trace_on"] > 0
+    assert 0.0 <= ab["overhead_frac"] <= 1.0
+    assert ab["repeats"] == 1
 
 
 def test_loadgen_capacity_sweep_artifact(tmp_path):
@@ -235,6 +271,22 @@ def test_serve_bench_guard_logic():
     assert ok
     # mismatched metrics (capacity vs throughput artifact) skip, not fail
     ok, msgs = guard.compare(cap, base)
+    assert ok and any("SKIP" in m for m in msgs)
+    # span-tracing overhead budget: >2% in the fresh artifact's own A/B
+    # fails on matching hardware; <=2% passes; absent (no --obs-ab) passes
+    heavy = {**base, "obs_overhead": {
+        "overhead_frac": 0.05, "decode_tok_s_trace_off": 600.0,
+        "decode_tok_s_trace_on": 570.0, "repeats": 3}}
+    ok, msgs = guard.compare(base, heavy)
+    assert not ok and any("tracing overhead" in m for m in msgs)
+    light = {**base, "obs_overhead": {
+        "overhead_frac": 0.01, "decode_tok_s_trace_off": 600.0,
+        "decode_tok_s_trace_on": 594.0, "repeats": 3}}
+    ok, _ = guard.compare(base, light)
+    assert ok
+    # hardware mismatch still skips BEFORE the overhead check fires
+    ok, msgs = guard.compare(base, {**heavy, "platform": {"backend": "tpu",
+                                                          "device": "v4"}})
     assert ok and any("SKIP" in m for m in msgs)
 
 

@@ -92,6 +92,16 @@ def main():
         help="capture a jax.profiler trace of N steps (after the compile step)",
     )
     parser.add_argument(
+        "--profile-window",
+        default=None,
+        metavar="START:LEN",
+        help="capture a jax.profiler trace of the step window "
+        "[START, START+LEN) — an absolute-step twin of --profile for "
+        "profiling steady state or a suspect step range mid-run (e.g. "
+        "1000:20). Lands in training.profile_dir next to the "
+        "flight-recorder dumps",
+    )
+    parser.add_argument(
         "--memory-analysis",
         action="store_true",
         default=False,
@@ -133,6 +143,16 @@ def main():
     if args.profile:
         cfg = dataclasses.replace(
             cfg, training=dataclasses.replace(cfg.training, profile_steps=args.profile)
+        )
+    if args.profile_window:
+        from zero_transformer_tpu.obs import parse_profile_window
+
+        p_start, p_len = parse_profile_window(args.profile_window)
+        cfg = dataclasses.replace(
+            cfg,
+            training=dataclasses.replace(
+                cfg.training, profile_start=p_start, profile_steps=p_len
+            ),
         )
     if args.audit_frequency is not None:
         cfg = dataclasses.replace(

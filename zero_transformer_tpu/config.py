@@ -274,6 +274,10 @@ class TrainingConfig:
     # capture a jax.profiler trace of this many consecutive steps (0 = off),
     # starting after the first (compile) step; viewable in TensorBoard/XProf
     profile_steps: int = 0
+    # absolute step at which the capture window opens (train.py
+    # --profile-window START:LEN sets both fields); 0 keeps the legacy
+    # "after the first step of this run" behavior
+    profile_start: int = 0
     profile_dir: str = ""  # default: <checkpoint.directory>/profile
     # stop (after force-saving a checkpoint) when the loss goes NaN/inf —
     # checked at each log sync point, so it costs nothing extra. The

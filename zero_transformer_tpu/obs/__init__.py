@@ -1,0 +1,72 @@
+"""Unified observability: spans, Prometheus metrics, flight recorder,
+on-demand profiling (PR 7).
+
+One subsystem threaded through serving (``serving/engine.py`` /
+``server.py``), training (``training/trainer.py`` / ``train.py``), and the
+resilience escalation seams:
+
+- ``Tracer``: ring-buffered span API (monotonic clock, fixed-tuple records,
+  no hot-path allocation beyond the record) instrumenting the serving
+  request lifecycle end-to-end and the training step loop; exports
+  Perfetto/Chrome-trace JSON and an incremental ``spans.jsonl``;
+- ``Registry`` / ``Counter`` / ``Gauge`` / ``Histogram``: Prometheus text
+  exposition (``/metrics`` content-negotiates it) backed by fixed-bucket
+  histograms — a scrape is O(buckets) and never holds the tick lock;
+- ``FlightRecorder``: bounded ring of recent tick summaries + events,
+  dumped automatically on breaker-open, anomaly halt, watchdog abort,
+  checkpoint quarantine, and drain;
+- ``ProfileWindow``: ``POST /admin/profile`` / ``train.py
+  --profile-window`` jax.profiler capture windows landing next to the
+  flight-recorder dumps;
+- ``logging``: MetricsLogger / StepTimer / MFU / per-device HBM stats
+  (``utils.monitoring`` is the compatibility facade over it).
+
+See docs/OBSERVABILITY.md for the span model, metric tables, and scrape
+configuration.
+"""
+from zero_transformer_tpu.obs.flight import FlightRecorder
+from zero_transformer_tpu.obs.logging import (
+    MetricsLogger,
+    StepTimer,
+    device_peak_flops,
+    hbm_device_stats,
+    hbm_used_gb,
+    mfu,
+    model_flops_per_token,
+    profile,
+)
+from zero_transformer_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from zero_transformer_tpu.obs.profiling import ProfileWindow, parse_profile_window
+from zero_transformer_tpu.obs.spans import (
+    Tracer,
+    coverage_fraction,
+    span_tree,
+)
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS",
+    "MetricsLogger",
+    "ProfileWindow",
+    "Registry",
+    "StepTimer",
+    "Tracer",
+    "coverage_fraction",
+    "device_peak_flops",
+    "hbm_device_stats",
+    "hbm_used_gb",
+    "mfu",
+    "model_flops_per_token",
+    "parse_profile_window",
+    "profile",
+    "span_tree",
+]

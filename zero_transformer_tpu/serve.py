@@ -349,6 +349,8 @@ def _server(gen: TextGenerator, args) -> None:
         page_size=args.page_size,
         page_pool_tokens=args.page_pool_tokens,
         draft_k=draft_k,
+        obs_dir=args.obs_dir or args.metrics_dir,
+        trace=not args.no_trace,
     )
     run_server(
         engine, gen.tokenizer, host=args.host, port=args.port,
@@ -512,6 +514,16 @@ def main(argv=None) -> None:
     p.add_argument("--metrics-dir", default=None,
                    help="JSONL sink for serving metrics (TTFT/ITL "
                         "percentiles, tokens/s, occupancy)")
+    p.add_argument("--obs-dir", default=None,
+                   help="observability run directory: flight-recorder dumps "
+                        "(breaker-open/drain post-mortems), on-demand "
+                        "profiler captures (POST /admin/profile), and span "
+                        "trace exports land here (defaults to --metrics-dir; "
+                        "unset disables dumps/profiling, not recording)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable span tracing (the bounded ring costs <2% "
+                        "decode tok/s — BENCH_serve.json obs_overhead is "
+                        "the measured number); /metrics histograms stay on")
     p.add_argument("--metrics-interval", type=int, default=200,
                    help="log serving metrics every N scheduler ticks")
     p.add_argument("--admin-token", default=None,

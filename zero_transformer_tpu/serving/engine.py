@@ -62,6 +62,25 @@ Resilience (``serving/resilience.py`` owns the primitives):
 - admission sheds requests whose deadline is provably infeasible given
   queue depth and the measured ITL EWMA (fast honest 503s, not timeout
   storms).
+
+Observability (``obs/`` owns the primitives — docs/OBSERVABILITY.md):
+
+- every request carries a REQUEST ID (client-supplied ``X-Request-Id`` or
+  generated at admission) and emits a well-nested span tree —
+  ``request`` ⊃ {``queue``, ``prefill``, ``decode``} — into the engine's
+  ring-buffered ``Tracer`` when it reaches a terminal state, whatever that
+  state is (done/shed/expired/cancelled/faulted). Per-tick phase spans
+  (``prefill_chunk``, ``decode_step``, ``emit``) land on the ``engine``
+  track, so a Perfetto view shows where each tick's milliseconds went;
+- latency metrics live in fixed-bucket ``obs.Histogram``s
+  (``serve_ttft_seconds`` etc.): ``metrics_snapshot()`` percentiles are
+  O(buckets) bucket walks and ``prometheus_text()`` renders the text
+  exposition — neither touches the scheduler lock (pre-PR7 every scrape
+  sorted three 10k-sample deques under it);
+- a ``FlightRecorder`` keeps the last N tick summaries/events in RAM and
+  dumps them (spans included) on breaker-open, drain, and abort;
+- ``request_profile(n)`` stages a ``jax.profiler`` capture of the next n
+  ticks (``POST /admin/profile``), started/stopped by the tick thread only.
 """
 from __future__ import annotations
 
@@ -70,13 +89,25 @@ import functools
 import itertools
 import math
 import queue as queue_mod
+import re
 import threading
 import time
+import uuid
 from collections import deque
+from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from zero_transformer_tpu.obs import (
+    LATENCY_BUCKETS,
+    FlightRecorder,
+    ProfileWindow,
+    Registry,
+    Tracer,
+    hbm_device_stats,
+)
 
 from zero_transformer_tpu.inference.generate import (
     _in_mesh,
@@ -112,6 +143,10 @@ from zero_transformer_tpu.serving.slots import (
     _leaf_name,
 )
 
+# characters stripped from client-supplied request ids (keep the usual
+# trace-id alphabets: alnum plus - _ . : / =)
+_RID_UNSAFE = re.compile(r"[^A-Za-z0-9._:/=-]")
+
 # request terminal states
 QUEUED = "queued"
 RUNNING = "running"
@@ -140,9 +175,22 @@ class Request:
 class RequestHandle:
     """Thread-safe view of a submitted request: token stream + final state."""
 
-    def __init__(self, request: Request, rid: int, submitted_at: float):
+    def __init__(self, request: Request, rid: int, submitted_at: float,
+                 request_id: Optional[str] = None):
         self.request = request
         self.id = rid
+        # correlation id: client-supplied (X-Request-Id) or generated —
+        # returned in the response header and the SSE done event, and the
+        # TRACK key of this request's span tree. SANITIZED to a safe header
+        # charset: the value is echoed verbatim into a response header, so
+        # CR/LF would let a client inject arbitrary headers (response
+        # splitting) and non-latin-1 would crash send_header mid-response;
+        # a client id that sanitizes to nothing falls back to a generated one
+        if request_id:
+            clean = _RID_UNSAFE.sub("", str(request_id))[:128]
+            self.rid = clean or uuid.uuid4().hex
+        else:
+            self.rid = uuid.uuid4().hex
         self.submitted_at = submitted_at
         self.status = QUEUED
         self.tokens: List[int] = []
@@ -162,6 +210,12 @@ class RequestHandle:
         # (TTFT minus queue wait), the clean denominator for prefix-cache
         # attribution under load
         self.admitted_at: Optional[float] = None
+        # when the prompt's K/V finished landing in the slot (install into
+        # the decode set) — the prefill/decode span boundary
+        self.prefill_done_at: Optional[float] = None
+        # the engine's Tracer; the lifecycle span tree is emitted from the
+        # timestamps above in ONE batch at _finish (zero per-token cost)
+        self._tracer: Optional[Tracer] = None
         self._events: queue_mod.Queue = queue_mod.Queue()
         self._done = threading.Event()
         self._cancel = threading.Event()
@@ -227,8 +281,31 @@ class RequestHandle:
         self.retryable = retryable
         self.retry_after = retry_after
         self.finished_at = now
+        tr = self._tracer
+        if tr is not None and tr.enabled:
+            self._emit_spans(now)
         self._events.put(("done", status))
         self._done.set()
+
+    def _emit_spans(self, fin: float) -> None:
+        """The request's span tree, from the lifecycle timestamps already on
+        this handle: root ``request`` = [submitted, finished]; phases
+        ``queue``/``prefill``/``decode`` partition it wherever the request
+        got before its terminal state. Contiguous by construction, so the
+        tree is always complete and well-nested — for done, shed, expired,
+        cancelled, and faulted outcomes alike."""
+        tr = self._tracer
+        sub, adm, pre = self.submitted_at, self.admitted_at, self.prefill_done_at
+        attrs = {"id": self.rid, "outcome": self.status,
+                 "tokens": len(self.tokens)}
+        if self.error:
+            attrs["error"] = self.error
+        tr.add("request", self.rid, sub, fin, attrs)
+        tr.add("queue", self.rid, sub, adm if adm is not None else fin, None)
+        if adm is not None:
+            tr.add("prefill", self.rid, adm, pre if pre is not None else fin, None)
+        if pre is not None:
+            tr.add("decode", self.rid, pre, fin, None)
 
 
 @dataclasses.dataclass
@@ -649,6 +726,10 @@ class ServingEngine:
         page_pool_tokens: int = 0,
         draft_k: int = 0,
         draft_fn: Optional[Callable[[Sequence[int], int], List[int]]] = None,
+        obs_dir: Optional[str] = None,
+        trace: bool = True,
+        trace_capacity: int = 8192,
+        flight_capacity: int = 256,
     ):
         self.cfg = cfg
         self.cache_len = cache_len or cfg.max_seq_len
@@ -825,16 +906,51 @@ class ServingEngine:
             "draft_tokens": 0,
             "accepted_tokens": 0,
         }
-        # bounded: an unbounded all-time sample list on a long-lived server
-        # is a slow memory leak AND makes every /metrics snapshot pay an
-        # O(n log n) sort of the full history; recent-window percentiles are
-        # the operationally useful ones anyway
-        self._ttft: deque = deque(maxlen=10_000)
-        self._itl: deque = deque(maxlen=10_000)
+        # observability (obs/): span tracer, Prometheus registry, flight
+        # recorder, on-demand profiler. Latency samples land in FIXED-BUCKET
+        # histograms — a /metrics read is an O(buckets) walk that never
+        # takes the scheduler lock (the pre-PR7 deques made every snapshot
+        # sort the 10k-sample history under it)
+        self.obs_dir = str(obs_dir) if obs_dir else None
+        self.tracer = Tracer(enabled=trace, capacity=trace_capacity, clock=clock)
+        self.registry = Registry()
+        self.flight = FlightRecorder(
+            directory=self.obs_dir, capacity=flight_capacity,
+            tracer=self.tracer, clock=clock,
+        )
+        self._profiler = ProfileWindow(self.obs_dir, prefix="serve")
+        self._h_ttft = self.registry.histogram(
+            "serve_ttft_seconds",
+            "Submit-to-first-token latency (queue wait included)",
+            LATENCY_BUCKETS,
+        )
+        self._h_itl = self.registry.histogram(
+            "serve_itl_seconds", "Inter-token latency, all decode ticks",
+            LATENCY_BUCKETS,
+        )
         # ITL samples from ticks that did NO prefill work — the pure-decode
         # floor; the gap between itl and itl_decode percentiles IS the
         # prefill interference the chunk budget exists to bound
-        self._itl_decode: deque = deque(maxlen=10_000)
+        self._h_itl_decode = self.registry.histogram(
+            "serve_itl_decode_seconds",
+            "Inter-token latency on ticks with no prefill work (decode floor)",
+            LATENCY_BUCKETS,
+        )
+        self._h_queue_wait = self.registry.histogram(
+            "serve_queue_wait_seconds", "Submit-to-slot-admission wait",
+            LATENCY_BUCKETS,
+        )
+        self._h_prefill = self.registry.histogram(
+            "serve_prefill_seconds",
+            "Admission-to-install prefill latency (prefix hits included)",
+            LATENCY_BUCKETS,
+        )
+        # legacy attribute names: tests and older callers measured the
+        # latency deques by len(); Histogram.__len__ keeps that contract
+        self._ttft = self._h_ttft
+        self._itl = self._h_itl
+        self._itl_decode = self._h_itl_decode
+        self._register_exports()
         self._started = self.now()
 
     # ----------------------------------------------------- device-state build
@@ -920,19 +1036,23 @@ class ServingEngine:
         seed: int = 0,
         deadline: Optional[float] = None,
         timeout: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> RequestHandle:
         """Enqueue a request; returns its handle immediately.
 
         ``timeout`` (seconds from now) is sugar for an absolute ``deadline``.
         A full queue or invalid request returns a handle already finished as
         ``rejected`` (callers map that to HTTP 429 / 400) — the error string
-        says which.
+        says which. ``request_id`` threads an inbound correlation id
+        (``X-Request-Id``) through the span tree and response; omitted, one
+        is generated here at admission.
         """
         now = self.now()
         if timeout is not None:
             deadline = now + timeout if deadline is None else min(deadline, now + timeout)
         request = Request(list(prompt), int(max_new_tokens), int(seed), deadline)
-        handle = RequestHandle(request, next(self._ids), now)
+        handle = RequestHandle(request, next(self._ids), now, request_id=request_id)
+        handle._tracer = self.tracer
         invalid = self._validate(request)
         with self._lock:
             if self._dead is not None:
@@ -1139,6 +1259,7 @@ class ServingEngine:
                 raise
             handle.prefix_hit_tokens = fill
             handle.admitted_at = self.now()
+            self._h_queue_wait.observe(handle.admitted_at - handle.submitted_at)
             handle.status = RUNNING
             self._prefilling[slot] = _PrefillJob(handle, fill=fill)
 
@@ -1176,6 +1297,9 @@ class ServingEngine:
                 if handle is None:
                     return
                 handle.admitted_at = self.now()
+                self._h_queue_wait.observe(
+                    handle.admitted_at - handle.submitted_at
+                )
                 try:
                     logits_row, small_cache = self._prefill(handle.request.prompt)
                     slot = self.slots.acquire()
@@ -1192,6 +1316,10 @@ class ServingEngine:
                     )
                     raise
                 handle.status = RUNNING
+                handle.prefill_done_at = self.now()
+                self._h_prefill.observe(
+                    handle.prefill_done_at - handle.admitted_at
+                )
                 self._active[slot] = _ActiveSlot(handle)
                 installs.append(
                     (slot, logits_row[0], jax.random.PRNGKey(handle.request.seed))
@@ -1289,6 +1417,7 @@ class ServingEngine:
             self._event("page_preemption", slots=len(faulted), phase="prefill")
             if not self._prefilling:
                 return True
+        t_chunk = self.now() if self.tracer.enabled else 0.0
         try:
             if self._chaos is not None:
                 self._chaos.on_prefill_chunk(self._tick)
@@ -1322,6 +1451,11 @@ class ServingEngine:
         except Exception as exc:
             self._on_prefill_fault(exc)
             return True
+        if self.tracer.enabled:
+            self.tracer.add(
+                "prefill_chunk", "engine", t_chunk, self.now(),
+                {"tick": self._tick, "slots": sum(active)},
+            )
         self.slots.cache = cache
         self.stats["prefill_chunks"] += sum(active)
         completed = []
@@ -1390,8 +1524,12 @@ class ServingEngine:
             self._veto = jnp.where(
                 jnp.asarray(mask, jnp.bool_), -1, self._veto
             )
+        t_done = self.now()
         for slot, job in completed:
             del self._prefilling[slot]
+            job.handle.prefill_done_at = t_done
+            if job.handle.admitted_at is not None:
+                self._h_prefill.observe(t_done - job.handle.admitted_at)
             self._active[slot] = _ActiveSlot(job.handle)
             self.stats["peak_occupancy"] = max(
                 self.stats["peak_occupancy"], self.active_count
@@ -1518,6 +1656,14 @@ class ServingEngine:
         """One scheduler tick: swap-in reload, sweep, admit, chunk-prefill
         budget (one chunk per mid-prefill slot, batched), supervised fused
         decode, emit, retire. Returns False when there was nothing to do."""
+        # staged profile windows start/advance/stop here — the tick thread
+        # owns the process-global jax profiler. Keyed on the BUSY-tick
+        # counter (self._tick), so "capture N ticks" means N ticks of real
+        # work, not N idle spins of the scheduler loop
+        self._profiler.poll(self._tick)
+        tr = self.tracer
+        tick_idx = self._tick
+        t_tick = self.now() if tr.enabled else 0.0
         self._swap_pending_params()
         self._sweep_queue()
         self._sweep_active()
@@ -1535,6 +1681,13 @@ class ServingEngine:
             if ran_prefill:
                 # prefill-only tick: nothing decodes yet, but the tick did
                 # real work and the loop must not sleep
+                if tr.enabled:
+                    tr.add("tick", "engine", t_tick, self.now(),
+                           {"tick": tick_idx, "phase": "prefill_only"})
+                self.flight.tick({
+                    "tick": tick_idx, "prefilling": len(self._prefilling),
+                    "active": 0, "queued": len(self._queue), "emitted": 0,
+                })
                 self._tick += 1
                 return True
             return False
@@ -1542,6 +1695,7 @@ class ServingEngine:
         # -- supervised region: a fault here poisons AT MOST this tick's
         # active slots, never the scheduler thread (run() stays alive and
         # queued requests admit on the next tick)
+        t_dec = self.now() if tr.enabled else 0.0
         try:
             if self._chaos is not None:
                 self._chaos.on_tick(self._tick)
@@ -1577,9 +1731,21 @@ class ServingEngine:
                 blocks = [[int(t)] for t in tokens.tolist()]
                 n_emits = [1] * self.n_slots
         except Exception as exc:
+            # ring entry FIRST: a breaker trip inside _on_tick_fault dumps
+            # the recorder, and the dump must contain the tick that tripped
+            self.flight.tick({
+                "tick": tick_idx, "fault": True, "error": repr(exc),
+                "queued": len(self._queue),
+            })
             self._on_tick_fault(exc)
             self._tick += 1
             return True
+        if tr.enabled:
+            # decode_step covers dispatch + the device_get sync — the
+            # on-device milliseconds of this tick
+            tr.add("decode_step", "engine", t_dec, self.now(),
+                   {"tick": tick_idx, "active": self.active_count,
+                    "spec": bool(self.draft_k)})
         if self._breaker.record_clean():
             self._rebuilds_since_recovery = 0
             if not self.draining:
@@ -1591,6 +1757,7 @@ class ServingEngine:
         poisoned: List[int] = []
         ttft_new: List[float] = []
         itl_new: List[float] = []
+        tokens_before = self.stats["tokens_out"]
         for slot, act in enumerate(self._active):
             if act is None:
                 continue
@@ -1644,22 +1811,31 @@ class ServingEngine:
             self._last_logits = jnp.where(keep[:, None], self._last_logits, 0.0)
         if poisoned:
             self._event("poisoned_slots", slots=len(poisoned))
-        if ttft_new or itl_new:
-            # under the lock: metrics_snapshot copies these deques from HTTP
-            # handler threads, and CPython raises on a deque mutated
-            # mid-iteration
-            with self._lock:
-                self._ttft.extend(ttft_new)
-                self._itl.extend(itl_new)
-                if not self._prefill_work:
-                    # per-phase attribution: this tick ran no prefill work
-                    # (chunk, span copy, or one-shot admission), so these
-                    # samples are the pure-decode ITL floor
-                    self._itl_decode.extend(itl_new)
-            for sample in itl_new:
-                self._itl_ewma.update(sample)
+        # histograms carry their own micro-locks — no scheduler lock, and a
+        # concurrent /metrics scrape reads bucket counts, never a sample list
+        for sample in ttft_new:
+            self._h_ttft.observe(sample)
+        for sample in itl_new:
+            self._h_itl.observe(sample)
+            if not self._prefill_work:
+                # per-phase attribution: this tick ran no prefill work
+                # (chunk, span copy, or one-shot admission), so these
+                # samples are the pure-decode ITL floor
+                self._h_itl_decode.observe(sample)
+            self._itl_ewma.update(sample)
         self._retire(finished)
 
+        emitted_total = self.stats["tokens_out"] - tokens_before
+        if tr.enabled:
+            tr.add("emit", "engine", now, self.now(),
+                   {"tick": tick_idx, "finished": len(finished)})
+            tr.add("tick", "engine", t_tick, self.now(), {"tick": tick_idx})
+        self.flight.tick({
+            "tick": tick_idx, "active": self.active_count,
+            "prefilling": len(self._prefilling), "queued": len(self._queue),
+            "emitted": emitted_total, "finished": len(finished),
+            "poisoned": len(poisoned),
+        })
         self._tick += 1
         if (
             self.metrics is not None
@@ -1760,7 +1936,10 @@ class ServingEngine:
 
     def _event(self, name: str, **fields) -> None:
         """Resilience incident -> the same JSONL/wandb timeline the training
-        stack writes (MetricsLogger.event), keyed by scheduler tick."""
+        stack writes (MetricsLogger.event), keyed by scheduler tick — and
+        into the flight recorder's ring, so a later dump carries the event
+        context even when no MetricsLogger is attached."""
+        self.flight.event(name, tick=self._tick, **fields)
         if self.metrics is not None:
             self.metrics.event(name, step=self._tick, **fields)
 
@@ -1817,6 +1996,15 @@ class ServingEngine:
                 reason=f"breaker open after {self._breaker.threshold} faults",
             )
             self._event("breaker_trip", trips=self.stats["breaker_trips"])
+            # post-mortem without verbose logging: the last N ticks of
+            # context (summaries, events, span tail) land in the run dir
+            # the moment the breaker opens, while the evidence is still in
+            # the ring
+            self.flight.dump(
+                "breaker_open",
+                extra={"error": repr(exc), "tick": self._tick,
+                       "trips": self.stats["breaker_trips"]},
+            )
             # the executable itself is suspect only once faults PERSIST:
             # swap in a privately jitted step on each trip (the spec step
             # is the same executable family — swap it with its twin)
@@ -1937,6 +2125,12 @@ class ServingEngine:
         self._event(
             "drain_done", forced=forced, drain_latency_s=self.drain_latency_s
         )
+        self._profiler.abort()  # never leave the process-global trace running
+        self.flight.dump(
+            "drain",
+            extra={"forced": forced, "drain_latency_s": self.drain_latency_s},
+        )
+        self.export_trace()
 
     # ------------------------------------------------------------ hot reload
 
@@ -2083,6 +2277,12 @@ class ServingEngine:
                 self._active[slot] = None
         for slot in sorted(self._prefilling):
             self._prefilling.pop(slot).handle._finish(FAILED, now, error=reason)
+        self._profiler.abort()
+        if "drained" not in reason:
+            # a drain already dumped through _finish_drain; every OTHER path
+            # here is an outage worth a post-mortem window
+            self.flight.dump("abort", extra={"reason": reason})
+            self.export_trace()
 
     def run_until_idle(self, max_ticks: int = 100_000) -> None:
         """Drive the scheduler synchronously until queue and slots drain
@@ -2138,14 +2338,16 @@ class ServingEngine:
                 "prefix_evictions": 0, "prefix_entries": 0,
                 "prefix_hit_rate": 0.0,
             })
-        with self._lock:  # step() extends these under the same lock
-            ttft, itl = list(self._ttft), list(self._itl)
-            itl_decode = list(self._itl_decode)
-        for name, samples in (
-            ("ttft_ms", ttft), ("itl_ms", itl), ("itl_decode_ms", itl_decode),
+        # percentiles straight from the fixed-bucket histograms: O(buckets)
+        # per quantile, no sample-list copy, no scheduler lock (the pre-PR7
+        # deque sort under self._lock was the known scrape cost here)
+        for name, hist in (
+            ("ttft_ms", self._h_ttft),
+            ("itl_ms", self._h_itl),
+            ("itl_decode_ms", self._h_itl_decode),
         ):
-            for pct, val in _percentiles(samples).items():
-                snap[f"{name}_{pct}"] = val * 1e3
+            for q in (50, 90, 99):
+                snap[f"{name}_p{q}"] = hist.quantile(q / 100.0) * 1e3
         for k in (
             "submitted", "completed", "rejected_queue_full", "rejected_invalid",
             "expired_queued", "expired_decoding", "cancelled", "tokens_out",
@@ -2159,3 +2361,166 @@ class ServingEngine:
         ):
             snap[k] = self.stats[k]
         return snap
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (``text/plain; version=0.0.4``) of the
+        registry: histograms directly, host counters/gauges through
+        scrape-time callbacks — the tick thread never pays for exposition."""
+        return self.registry.render()
+
+    def _register_exports(self) -> None:
+        """Wire the host-side ``stats`` counters and live gauges into the
+        Prometheus registry as scrape-time callbacks (the hot path keeps
+        its plain-int increments; only a scrape pays the read)."""
+        reg = self.registry
+        for key, help_text in (
+            ("submitted", "Requests submitted (accepted + rejected)"),
+            ("completed", "Requests finished with status done"),
+            ("rejected_queue_full", "Admission rejections: queue full"),
+            ("rejected_invalid", "Admission rejections: invalid request"),
+            ("rejected_draining", "Admission rejections while draining"),
+            ("shed_infeasible", "Deadline-infeasible sheds at admission"),
+            ("expired_queued", "Deadline expiries while queued"),
+            ("expired_prefilling", "Deadline expiries during prefill"),
+            ("expired_decoding", "Deadline expiries mid-decode"),
+            ("cancelled", "Client cancellations honored"),
+            ("tokens_out", "Tokens emitted to clients"),
+            ("tick_faults", "Supervised decode-tick faults"),
+            ("poisoned_slots", "Slots retired by the non-finite guard"),
+            ("breaker_trips", "Circuit-breaker trips (DEGRADED + rebuild)"),
+            ("drain_forced", "Generations force-finished at drain deadline"),
+            ("reloads", "Hot weight reloads swapped in"),
+            ("reloads_rejected", "Hot weight reloads rejected"),
+            ("prefill_chunks", "Chunk-prefill row dispatches"),
+            ("prefill_faults", "Supervised chunk-prefill faults"),
+            ("prefill_bucket_capped", "One-shot prefill bucket-cap events"),
+            ("page_faults", "Page-pool exhaustions that reclaimed prefix pages"),
+            ("pages_reclaimed", "Prefix-cache pages reclaimed under pressure"),
+            ("preemptions", "Requests preempted for KV pages (last resort)"),
+            ("spec_ticks", "Speculative decode ticks"),
+            ("draft_tokens", "Draft tokens proposed"),
+            ("accepted_tokens", "Draft tokens accepted by verify"),
+        ):
+            reg.counter_func(
+                f"serve_{key}", help_text,
+                (lambda k=key: self.stats[k]),
+            )
+        reg.gauge_func(
+            "serve_queue_depth", "Requests waiting for a slot",
+            lambda: len(self._queue),
+        )
+        reg.gauge_func(
+            "serve_slot_occupancy", "Slots actively decoding",
+            lambda: self.active_count,
+        )
+        reg.gauge_func(
+            "serve_prefilling_slots", "Slots mid-chunked-prefill",
+            lambda: len(self._prefilling),
+        )
+        reg.gauge_func(
+            "serve_slots", "Configured decode slots", lambda: self.n_slots
+        )
+        reg.gauge_func(
+            "serve_breaker_open", "1 while the circuit breaker is open",
+            lambda: 1 if self._breaker.open else 0,
+        )
+        reg.gauge_func(
+            "serve_uptime_seconds", "Engine lifetime on its own clock",
+            lambda: self.lifecycle.uptime_s,
+        )
+        reg.gauge_func(
+            "serve_itl_ewma_seconds", "Shedding's measured ITL EWMA",
+            lambda: self._itl_ewma.value or 0.0,
+        )
+        reg.gauge_func(
+            "serve_prefill_buckets", "Compiled one-shot prefill buckets",
+            lambda: len(self._buckets_seen),
+        )
+        reg.gauge_func(
+            "serve_page_pool_util", "Paged-KV pool utilization (0 when slab)",
+            lambda: (
+                self.slots.page_pool_util if self.kv_layout == "paged" else 0.0
+            ),
+        )
+        reg.gauge_func(
+            "serve_prefix_cache_entries", "Prefix-cache entries resident",
+            lambda: (
+                len(self._prefix_cache) if self._prefix_cache is not None else 0
+            ),
+        )
+        reg.gauge_func(
+            "serve_trace_spans_dropped",
+            "Spans pushed out of the bounded trace ring",
+            lambda: self.tracer.dropped,
+        )
+        # per-device HBM with max/mean rollups (None on backends without
+        # memory stats — the callbacks then render no samples). One shared
+        # short-TTL read per scrape: the three gauges render back to back,
+        # and each hbm_device_stats() call is a memory_stats runtime query
+        # PER DEVICE — tripling that per scrape is pure waste
+        hbm_cache = {"t": -1.0, "v": None}
+
+        def _hbm() -> dict:
+            t = time.monotonic()
+            if t - hbm_cache["t"] > 0.25:
+                hbm_cache["v"] = hbm_device_stats()
+                hbm_cache["t"] = t
+            return hbm_cache["v"] or {}
+
+        reg.gauge_func(
+            "hbm_used_gigabytes", "Per-device HBM in use",
+            lambda: [
+                ({"device": str(i)}, gb)
+                for i, gb in enumerate(_hbm().get("per_device_gb", []))
+            ],
+        )
+        reg.gauge_func(
+            "hbm_used_gigabytes_max", "Max HBM in use across local devices",
+            lambda: _hbm().get("max_gb"),
+        )
+        reg.gauge_func(
+            "hbm_used_gigabytes_mean", "Mean HBM in use across local devices",
+            lambda: _hbm().get("mean_gb"),
+        )
+
+    # ------------------------------------------------------------- profiling
+
+    def request_profile(self, ticks: int) -> Dict[str, Any]:
+        """Stage a ``jax.profiler`` capture of the next ``ticks`` scheduler
+        ticks (``POST /admin/profile`` lands here). Thread-safe staging;
+        the tick thread alone starts/stops the trace. Raises RuntimeError
+        while draining/stopped, without an ``obs_dir``, or when a capture
+        is already in progress."""
+        with self._lock:
+            if self._dead is not None:
+                raise RuntimeError(f"engine is not serving: {self._dead}")
+            if self.lifecycle.state == DRAINING:
+                raise RuntimeError(
+                    "engine is draining; profile capture rejected"
+                )
+            info = self._profiler.request(
+                ticks, name=f"serve_tick{self._tick}"
+            )
+        self._event("profile_requested", ticks=ticks, path=info["path"])
+        return info
+
+    @property
+    def profile_active(self) -> bool:
+        return self._profiler.active
+
+    @property
+    def profiles_completed(self) -> List[str]:
+        return list(self._profiler.completed)
+
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the span ring as Perfetto/Chrome-trace JSON (default:
+        ``<obs_dir>/trace_serve.json``) plus an incremental append to
+        ``<obs_dir>/spans.jsonl`` beside ``metrics.jsonl``."""
+        if path is None:
+            if self.obs_dir is None:
+                return None
+            path = str(Path(self.obs_dir) / "trace_serve.json")
+        out = self.tracer.write_chrome_trace(path)
+        if self.obs_dir is not None:
+            self.tracer.write_jsonl(Path(self.obs_dir) / "spans.jsonl")
+        return out

@@ -15,13 +15,28 @@ CUDA+gradio app (reference ``app.py``). Endpoints:
   only when READY; 503 while starting, degraded (breaker open), draining,
   or stopped, so a load balancer routes around a sick replica. Body:
   ``{"state", "uptime_s", "reloads", "breaker_open", ...}``.
-- ``GET /metrics``: the full serving-metrics snapshot (TTFT/ITL percentiles
-  — with a pure-decode ``itl_decode_ms_*`` split isolating chunked-prefill
-  interference — tokens/s, rejects, prefix-cache hit/miss/entry counters,
-  compiled prefill-bucket gauge, resilience counters) as JSON.
+- ``GET /metrics``: content-negotiated. The default stays the JSON snapshot
+  (TTFT/ITL percentiles — with a pure-decode ``itl_decode_ms_*`` split
+  isolating chunked-prefill interference — tokens/s, rejects, prefix-cache
+  hit/miss/entry counters, compiled prefill-bucket gauge, resilience
+  counters); an ``Accept`` header naming ``text/plain`` or ``openmetrics``
+  (what a Prometheus scraper sends), or ``?format=prometheus``, gets the
+  text exposition format backed by the engine's fixed-bucket histograms —
+  O(buckets) per scrape, never the tick lock (docs/OBSERVABILITY.md).
 - ``POST /admin/reload``: hot weight reload — load a standby msgpack tree
   off the tick thread, validate, swap between ticks without dropping a
   slot (also wired to SIGHUP by ``install_signal_handlers``).
+- ``POST /admin/profile``: ``{"ticks": N}`` captures a ``jax.profiler``
+  trace of the next N scheduler ticks into the engine's obs directory
+  (same loopback/bearer-token gate as reload; 409 while DRAINING or when a
+  capture is already running).
+
+Request correlation: every request carries an id — inbound ``X-Request-Id``
+(or body ``request_id``) when the caller supplies one for cross-service
+correlation, generated at admission otherwise — echoed as an
+``X-Request-Id`` response header on every /generate response (SSE and JSON,
+success and rejection) and as ``request_id`` in the final SSE event. The
+same id keys the request's span tree in the engine's tracer.
 
 One scheduler thread drives ``engine.step()``; HTTP handler threads only
 ``submit()`` and drain per-request queues, so a slow client never stalls
@@ -109,15 +124,37 @@ class ServingServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
-                if self.path == "/healthz":
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
                     self._json(*outer._healthz())
-                elif self.path == "/metrics":
-                    self._json(200, outer.engine.metrics_snapshot())
+                elif path == "/metrics":
+                    accept = self.headers.get("Accept") or ""
+                    if (
+                        "format=prometheus" in query
+                        or "text/plain" in accept
+                        or "openmetrics" in accept
+                    ):
+                        # the Prometheus scrape path: its Accept header
+                        # names text/plain;version=0.0.4 (and/or
+                        # openmetrics); JSON dashboards keep the default
+                        body = outer.engine.prometheus_text().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._json(200, outer.engine.metrics_snapshot())
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802
-                if self.path not in ("/generate", "/admin/reload"):
+                if self.path not in (
+                    "/generate", "/admin/reload", "/admin/profile",
+                ):
                     self._json(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -149,12 +186,15 @@ class ServingServer:
                     # client's error, not a handler-thread traceback
                     self._json(400, {"error": "body must be a JSON object"})
                     return
-                if self.path == "/admin/reload":
+                if self.path.startswith("/admin/"):
                     if not outer._admin_allowed(self):
                         self._json(403, {"error": "admin endpoint: loopback "
                                                   "or bearer token required"})
                         return
-                    self._json(*outer._reload(req))
+                    if self.path == "/admin/reload":
+                        self._json(*outer._reload(req))
+                    else:
+                        self._json(*outer._profile(req))
                 else:
                     outer._generate(self, req)
 
@@ -266,6 +306,24 @@ class ServingServer:
             "state": self.engine.lifecycle.state,
         }
 
+    def _profile(self, req: dict):
+        """(code, body) for POST /admin/profile: stage a jax.profiler
+        capture of the next N scheduler ticks, landing in the engine's obs
+        directory next to the flight-recorder dumps. 202 (the capture runs
+        asynchronously on the tick thread); 409 while draining, when a
+        capture is already in progress, or without an obs directory."""
+        try:
+            ticks = int(req.get("ticks", 20))
+        except (TypeError, ValueError):
+            return 400, {"error": "ticks must be an integer"}
+        try:
+            info = self.engine.request_profile(ticks)
+        except ValueError as exc:
+            return 400, {"error": str(exc)}
+        except RuntimeError as exc:
+            return 409, {"error": str(exc), "state": self.engine.lifecycle.state}
+        return 202, {"accepted": True, **info}
+
     def drain(self, deadline_s: Optional[float] = 30.0) -> None:
         """Begin a graceful drain and, once the engine reports STOPPED (or
         the deadline plus grace expires), shut the HTTP server down.
@@ -315,7 +373,7 @@ class ServingServer:
 
     # -------------------------------------------------------------- request
 
-    def _submit(self, req: dict):
+    def _submit(self, req: dict, request_id: Optional[str] = None):
         if "tokens" in req:
             ids = [int(t) for t in req["tokens"]]
         else:
@@ -325,16 +383,22 @@ class ServingServer:
             max_new_tokens=int(req.get("max_new_tokens", 32)),
             seed=int(req.get("seed", 0)),
             timeout=float(req["timeout"]) if "timeout" in req else None,
+            request_id=request_id,
         )
 
     def _generate(self, handler, req: dict) -> None:
+        # inbound correlation id (header wins over body field); the engine
+        # generates one at admission when the client sent none — either way
+        # every response carries it back as X-Request-Id
+        rid_in = handler.headers.get("X-Request-Id") or req.get("request_id")
         try:
-            handle = self._submit(req)
+            handle = self._submit(req, request_id=rid_in)
         except (TypeError, ValueError) as exc:
             # ill-typed field VALUES ({"timeout": "abc"}) are the client's
             # error — 400, not a dropped connection with a server traceback
             handler._json(400, {"error": f"bad request field: {exc}"})
             return
+        rid_hdr = {"X-Request-Id": handle.rid}
         if handle.status == REJECTED:
             if handle.retryable:
                 # drain / shed / backpressure: honest fast failure the
@@ -343,20 +407,26 @@ class ServingServer:
                 code = 429 if "queue full" in (handle.error or "") else 503
                 handler._json(
                     code,
-                    {"error": handle.error, "status": handle.status},
+                    {"error": handle.error, "status": handle.status,
+                     "request_id": handle.rid},
                     headers={
                         "Retry-After": str(
                             max(1, math.ceil(handle.retry_after or 1.0))
-                        )
+                        ),
+                        **rid_hdr,
                     },
                 )
             else:
-                handler._json(400, {"error": handle.error, "status": handle.status})
+                handler._json(400, {"error": handle.error,
+                                    "status": handle.status,
+                                    "request_id": handle.rid},
+                              headers=rid_hdr)
             return
         if handle.status == FAILED:
             # dead engine: an outage must read as 503, never as a 200 with
             # zero tokens
-            handler._json(503, {"error": handle.error, "status": handle.status})
+            handler._json(503, {"error": handle.error, "status": handle.status,
+                                "request_id": handle.rid}, headers=rid_hdr)
             return
         if not req.get("stream", True):
             tokens = handle.result()
@@ -364,16 +434,20 @@ class ServingServer:
                 # the engine died AFTER admission — same outage as the
                 # submit-time check above, same 503 (never a 200 with an
                 # empty/truncated body a load balancer reads as healthy)
-                handler._json(503, {"error": handle.error, "status": handle.status})
+                handler._json(503, {"error": handle.error,
+                                    "status": handle.status,
+                                    "request_id": handle.rid}, headers=rid_hdr)
                 return
             text = self._full_text(tokens)
             handler._json(200, {
                 "status": handle.status, "tokens": tokens, "text": text,
-            })
+                "request_id": handle.rid,
+            }, headers=rid_hdr)
             return
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
         handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("X-Request-Id", handle.rid)
         handler.end_headers()
         decoder = StreamDecoder(self.tokenizer)
         pieces: list = []
@@ -412,6 +486,7 @@ class ServingServer:
                 "status": handle.status,
                 "text": "".join(pieces),
                 "error": handle.error,
+                "request_id": handle.rid,
             })
         except (BrokenPipeError, ConnectionResetError):
             # client went away: release the slot instead of decoding into
@@ -453,7 +528,8 @@ def run_server(
     print(
         f"serving on http://{host}:{server.port} "
         f"({engine.n_slots} slots, cache_len {engine.cache_len}) — "
-        "POST /generate, GET /healthz, GET /metrics, POST /admin/reload; "
+        "POST /generate, GET /healthz, GET /metrics (JSON; Prometheus text "
+        "via Accept: text/plain), POST /admin/reload, POST /admin/profile; "
         f"SIGTERM drains ({drain_deadline_s}s deadline), SIGHUP reloads",
         flush=True,
     )

@@ -33,6 +33,7 @@ from zero_transformer_tpu.parallel.zero import (
     make_train_step,
 )
 from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
+from zero_transformer_tpu.obs import FlightRecorder, Tracer
 from zero_transformer_tpu.utils import monitoring
 from zero_transformer_tpu.utils.jax_compat import ensure_donatable
 
@@ -265,6 +266,24 @@ class Trainer:
             # full flattened run config at init (reference main_zero.py:354-366)
             config=flatten_config(cfg),
         )
+        # observability (obs/): the step loop records per-phase spans (data
+        # fetch, dispatch, device sync, checkpoint save, replica audit) into
+        # a bounded tracer, and a flight recorder keeps the last N step
+        # summaries + events for the post-mortem dump fired on anomaly
+        # halt, watchdog abort, and checkpoint quarantine. Both export to
+        # the run directory beside metrics.jsonl (local dirs only — object
+        # stores have no append/dump semantics here).
+        from zero_transformer_tpu.utils.paths import is_remote_path
+
+        obs_dir = (
+            cfg.checkpoint.directory
+            if cfg.checkpoint.directory
+            and not is_remote_path(cfg.checkpoint.directory)
+            and jax.process_index() == 0
+            else None
+        )
+        self.tracer = Tracer(capacity=16384)
+        self.flight = FlightRecorder(directory=obs_dir, tracer=self.tracer)
         self.rng = jax.random.PRNGKey(cfg.training.seed)
         # validation window pin: source state captured at first evaluate(),
         # restored before every later one, so eval always scores the SAME
@@ -335,7 +354,7 @@ class Trainer:
             state, meta, report = self.ckpt.restore_verified(
                 self.abstract_state(),
                 check_meta=self._check_restore_meta,
-                on_event=self.metrics.event,
+                on_event=self._restore_event,
             )
             self._restore_report = report
             # restored buffers may be zero-copy views the runtime does not
@@ -402,6 +421,16 @@ class Trainer:
                 log.info("warm-initialized params from %s", ck.warm_init_dir)
         self.state = state
         return state
+
+    def _restore_event(self, name: str, step: int, **fields) -> None:
+        """Restore-path events -> metrics timeline AND flight recorder; a
+        quarantined checkpoint additionally dumps the recorder window (the
+        post-mortem for WHY a step dir failed its digest belongs next to
+        the quarantined artifact — docs/RESILIENCE.md)."""
+        self.metrics.event(name, step, **fields)
+        self.flight.event(name, step=step, **fields)
+        if name == "ckpt_quarantined":
+            self.flight.dump("quarantine", extra={"step": step, **fields})
 
     def _warm_params_from_msgpack(self, path: str):
         """Load donor params, auto-extend depth / convert layer layout to this
@@ -553,9 +582,27 @@ class Trainer:
         )
         preempted, restore_handler = self._install_preemption_handler()
         profile_dir = cfg.profile_dir or f"{self.cfg.checkpoint.directory}/profile"
-        # trace window [start+1, start+1+profile_steps): skips the compile step
-        profile_stop = start + 1 + cfg.profile_steps if cfg.profile_steps else None
+        # trace window: start_trace fires at loop top when the COMPLETED
+        # step counter equals profile_trigger, so the traced steps are
+        # [trigger+1, trigger+profile_steps]. The legacy default
+        # (profile_start=0) keeps its historical trigger of start+1
+        # (skip the compile step); --profile-window START:LEN pins the
+        # absolute window [START, START+LEN) -> trigger START-1
+        # (obs/profiling.py parses the flag)
+        profile_trigger = (
+            cfg.profile_start - 1 if cfg.profile_start else start + 1
+        )
+        profile_stop = (
+            profile_trigger + cfg.profile_steps if cfg.profile_steps else None
+        )
+        if profile_stop and profile_trigger < start:
+            log.warning(
+                "profiler: window [%d, %d) is already behind resume step %d; "
+                "no capture this run", cfg.profile_start,
+                cfg.profile_start + cfg.profile_steps, start,
+            )
         profiling = False
+        tr = self.tracer
 
         # anomaly guard: in-graph detect-and-drop with a device-resident
         # carry; the host reads it only at log points (no per-step sync)
@@ -603,16 +650,27 @@ class Trainer:
         tick_step = start  # step at which the timing window last restarted
         try:
             while step < end:
-                if profile_stop and not profiling and step == start + 1:
+                if profile_stop and not profiling and step == profile_trigger:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
                     log.info("profiler: tracing %d steps to %s", cfg.profile_steps, profile_dir)
+                t_fetch = tr.clock()
                 local = next(it)
                 batch = device_put_batch(local, self.batch_sharding)
+                t_disp = tr.clock()
+                if tr.enabled:
+                    tr.add("data_fetch", "train", t_fetch, t_disp,
+                           {"step": step + 1})
                 if guard is not None:
                     state, metrics, carry = step_fn(state, batch, self.rng, carry)
                 else:
                     state, metrics = step_fn(state, batch, self.rng)
+                if tr.enabled:
+                    # dispatch, not compute: jax returns futures — the
+                    # device milliseconds show up in device_sync at the
+                    # next log point (and in a --profile-window capture)
+                    tr.add("dispatch", "train", t_disp, tr.clock(),
+                           {"step": step + 1})
                 step += 1
                 self.last_step = step
                 self._live = (step, state)
@@ -627,7 +685,13 @@ class Trainer:
 
                 paused = False
                 if step % cfg.log_frequency == 0 or step == end:
+                    t_sync = tr.clock()
                     loss = float(metrics["loss"])  # device sync point
+                    if tr.enabled:
+                        # host-blocked time waiting on the device: the gap
+                        # between dispatch rate and compute rate
+                        tr.add("device_sync", "train", t_sync, tr.clock(),
+                               {"step": step})
                     if (
                         cfg.halt_on_nan
                         and not jnp.isfinite(loss)
@@ -643,6 +707,11 @@ class Trainer:
                             "update was dropped in-graph (params still clean)"
                             if guard is not None
                             else "NOT checkpointed (state is already poisoned)"
+                        )
+                        self.flight.dump(
+                            "anomaly_halt",
+                            extra={"step": step, "loss": repr(loss),
+                                   "cause": "halt_on_nan"},
                         )
                         raise RuntimeError(
                             f"non-finite loss {loss} at step {step}; {poisoned} "
@@ -666,9 +735,14 @@ class Trainer:
                         util = monitoring.mfu(tok_s / n_chips, self.flops_per_token)
                         if util is not None:
                             payload["mfu"] = util
-                    hbm = monitoring.hbm_used_gb()
+                    hbm = monitoring.hbm_device_stats()
                     if hbm is not None:
-                        payload["hbm_gb"] = hbm
+                        # max across local devices (the OOM-relevant number;
+                        # the old device-0-only read hid a skewed shard),
+                        # mean alongside once there is more than one device
+                        payload["hbm_gb"] = hbm["max_gb"]
+                        if len(hbm["per_device_gb"]) > 1:
+                            payload["hbm_gb_mean"] = hbm["mean_gb"]
                     payload.update(self._data_fault_payload())
                     if self.ckpt.last_digest_ms:
                         # digest time of the most recent manifest-carrying
@@ -704,8 +778,24 @@ class Trainer:
                                 self.resilience_report["replica_audit_failures"]
                             )
                     self.metrics.log(payload, step, prefix="train")
+                    # flight ring + incremental span log, at log points only
+                    # (the hot loop appends fixed records; IO lands here)
+                    self.flight.tick({
+                        "step": step, "loss": loss,
+                        "grad_norm": payload["grad_norm"],
+                        "anomalies": self.resilience_report["anomalies"],
+                        "rollbacks": rollbacks,
+                        "audit_failures": self.resilience_report[
+                            "replica_audit_failures"
+                        ],
+                    })
+                    if self.flight.directory:
+                        tr.write_jsonl(
+                            f"{self.flight.directory}/spans.jsonl"
+                        )
                     tick_step = step
                     if guard is not None:
+                        t_audit = tr.clock()
                         state, carry, rolled = self._handle_replica_divergence(
                             new_audit, state, carry, guard, snapshot,
                             rollbacks, step,
@@ -754,12 +844,25 @@ class Trainer:
                         ):
                             snapshot.capture(state)
                             last_snap_step = step
+                        if tr.enabled:
+                            # guard-carry read + divergence/anomaly
+                            # escalation + snapshot refresh, as one phase
+                            tr.add("replica_audit", "train", t_audit,
+                                   tr.clock(), {"step": step,
+                                                "rolled": rolled})
 
                 if cfg.evaluation_frequency and step % cfg.evaluation_frequency == 0:
-                    self.metrics.log(self.evaluate(state), step, prefix="validation")
+                    with tr.span("evaluate", "train", step=step):
+                        self.metrics.log(
+                            self.evaluate(state), step, prefix="validation"
+                        )
                     paused = True
 
+                t_save = tr.clock()
                 if self.ckpt.save(step, state, meta=self._save_meta()):
+                    if tr.enabled:
+                        tr.add("checkpoint_save", "train", t_save, tr.clock(),
+                               {"step": step})
                     paused = True
                 if paused:
                     # exclude eval/checkpoint wall time from the throughput window
@@ -784,6 +887,11 @@ class Trainer:
                 self.resilience_report["watchdog_fired"] = True
                 self.metrics.event(
                     "watchdog_abort", step, timeout_s=res.watchdog_timeout_s
+                )
+                self.flight.dump(
+                    "watchdog_abort",
+                    extra={"step": step,
+                           "timeout_s": res.watchdog_timeout_s},
                 )
                 raise HangError(
                     f"train loop produced no step for more than "
@@ -866,6 +974,11 @@ class Trainer:
                 to_step=snapshot.step, rollback=rollbacks + 1,
             )
             return state, carry, True
+        self.flight.dump(
+            "anomaly_halt",
+            extra={"step": step, "cause": "replica_divergence",
+                   "new_failures": new},
+        )
         raise AnomalyHalt(
             f"cross-replica divergence at step {step} (audited every "
             f"{res.audit_frequency} steps): a DP replica's replicated state "
@@ -895,6 +1008,11 @@ class Trainer:
         from zero_transformer_tpu.resilience import AnomalyHalt
 
         if res.anomaly_response == "halt":
+            self.flight.dump(
+                "anomaly_halt",
+                extra={"step": step, "cause": "policy_halt", "new": new,
+                       "streak": stats.streak},
+            )
             raise AnomalyHalt(
                 f"anomaly policy 'halt': {new} flagged step(s) by step {step} "
                 f"(non-finite loss/grad or spike; streak {stats.streak}). "
@@ -908,6 +1026,11 @@ class Trainer:
             and snapshot.captured
         ):
             if rollbacks >= res.max_rollbacks:
+                self.flight.dump(
+                    "anomaly_halt",
+                    extra={"step": step, "cause": "rollback_budget",
+                           "streak": stats.streak},
+                )
                 raise AnomalyHalt(
                     f"rollback budget exhausted ({res.max_rollbacks}) with the "
                     f"anomaly streak still at {stats.streak} at step {step} — "
@@ -927,6 +1050,11 @@ class Trainer:
             )
             return state, carry, True
         if stats.streak >= res.max_consecutive_anomalies:
+            self.flight.dump(
+                "anomaly_halt",
+                extra={"step": step, "cause": "consecutive_anomalies",
+                       "streak": stats.streak},
+            )
             raise AnomalyHalt(
                 f"{stats.streak} consecutive anomalous steps at step {step}: "
                 f"every update is being dropped — no training progress is "
@@ -935,5 +1063,15 @@ class Trainer:
         return state, carry, False
 
     def close(self) -> None:
+        if self.flight.directory:
+            # Perfetto trace + remaining spans beside metrics.jsonl — the
+            # per-phase step timeline survives the process
+            try:
+                self.tracer.write_chrome_trace(
+                    f"{self.flight.directory}/trace_train.json"
+                )
+                self.tracer.write_jsonl(f"{self.flight.directory}/spans.jsonl")
+            except Exception:
+                log.exception("obs: trace export failed (run results intact)")
         self.ckpt.close()
         self.metrics.close()

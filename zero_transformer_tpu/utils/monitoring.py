@@ -1,199 +1,31 @@
-"""Observability: metrics logging, step timing, MFU, profiler capture.
+"""Compatibility facade over ``zero_transformer_tpu.obs`` (PR 7).
 
-The reference's observability is wandb-only (reference ``main_zero.py:354-366,
-504-529,559-562``) with no profiling and no MFU anywhere (SURVEY §5). Here:
-
-- ``MetricsLogger`` fans out to console, a JSONL file, and wandb when the
-  package is importable (this image has no wandb — it is import-gated);
-- ``model_flops_per_token`` / ``mfu`` give the 6N + attention FLOPs estimate
-  against per-chip peak;
-- ``StepTimer`` measures wall-per-step with a sync-on-read design (value
-  fetch, not ``block_until_ready`` — see bench.py note);
-- ``profile`` context manager wraps ``jax.profiler`` trace capture.
+This module used to own MetricsLogger / StepTimer / MFU / HBM helpers; they
+now live in ``obs/logging.py`` as part of the unified observability layer
+(spans, Prometheus metrics, flight recorder, profiling — see
+docs/OBSERVABILITY.md). Every pre-PR7 import path keeps working through the
+re-exports below; new code should import from ``zero_transformer_tpu.obs``.
 """
-from __future__ import annotations
+from zero_transformer_tpu.obs.logging import (  # noqa: F401
+    TPU_PEAK_FLOPS,
+    MetricsLogger,
+    StepTimer,
+    device_peak_flops,
+    hbm_device_stats,
+    hbm_used_gb,
+    mfu,
+    model_flops_per_token,
+    profile,
+)
 
-import contextlib
-import json
-import time
-from pathlib import Path
-from typing import Any, Dict, Optional
-
-import jax
-
-# bf16 peak FLOP/s per chip by TPU generation (public spec sheets)
-TPU_PEAK_FLOPS = {
-    "v3": 123e12 / 2,  # per chip (2 cores): 61.5 TF/core… v3 chip = 123 TF bf16
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
-
-def model_flops_per_token(
-    n_params: int, n_layers: int, d_model: int, seq_len: int, backward: bool = True
-) -> float:
-    """FLOPs per trained token: 6N (fwd+bwd matmuls) + 12·L·d·T attention term
-    (PaLM appendix-B style accounting)."""
-    mult = 3.0 if backward else 1.0
-    dense = 2.0 * n_params
-    attn = 4.0 * n_layers * d_model * seq_len  # qk^T + av, causal halves the 2x
-    return mult * (dense + attn)
-
-
-def device_peak_flops() -> Optional[float]:
-    kind = jax.devices()[0].device_kind.lower()
-    for key, val in TPU_PEAK_FLOPS.items():
-        if key in kind.replace(" ", "").replace("tpu", ""):
-            return val
-    if "v5lite" in kind.replace(" ", "") or "lite" in kind:
-        return TPU_PEAK_FLOPS["v5e"]
-    return None
-
-
-def mfu(
-    tokens_per_sec_per_chip: float,
-    flops_per_token: float,
-    peak_flops: Optional[float] = None,
-) -> Optional[float]:
-    peak = peak_flops if peak_flops is not None else device_peak_flops()
-    if not peak:
-        return None
-    return tokens_per_sec_per_chip * flops_per_token / peak
-
-
-def hbm_used_gb() -> Optional[float]:
-    """Device-0 HBM in use, GB (None where the backend exposes no stats —
-    CPU). The observability hook the reference never had: its OOMs were
-    discovered by crashing (reference ``logs/1B.md:7``)."""
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:
-        return None
-    if not stats or "bytes_in_use" not in stats:
-        return None
-    return stats["bytes_in_use"] / 1e9
-
-
-class MetricsLogger:
-    """Console + JSONL + optional-wandb metrics sink."""
-
-    def __init__(
-        self,
-        directory: Optional[str | Path] = None,
-        use_wandb: bool = False,
-        wandb_project: str = "zero-transformer-tpu",
-        config: Optional[dict] = None,
-        enabled: bool = True,
-    ):
-        self.enabled = enabled and jax.process_index() == 0
-        self._file = None
-        self._wandb = None
-        if not self.enabled:
-            return
-        if directory is not None:
-            from zero_transformer_tpu.utils.paths import is_remote_path
-
-            if is_remote_path(directory):
-                # remote run directory (gs:// etc.): object stores don't
-                # support the append-mode JSONL sink; wandb carries remote
-                # metrics, and the console line always prints.
-                print(f"metrics: remote directory {directory}; JSONL sink disabled "
-                      "(use wandb for remote metric history)", flush=True)
-            else:
-                path = Path(directory)
-                path.mkdir(parents=True, exist_ok=True)
-                self._file = open(path / "metrics.jsonl", "a", buffering=1)
-        if use_wandb:
-            try:
-                import wandb
-
-                self._wandb = wandb
-                wandb.init(project=wandb_project, config=config or {})
-            except ImportError:
-                pass
-
-    def log(self, metrics: Dict[str, Any], step: int, prefix: str = "") -> None:
-        if not self.enabled:
-            return
-        clean = {
-            (f"{prefix}/{k}" if prefix else k): (
-                float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v
-            )
-            for k, v in metrics.items()
-        }
-        if self._file:
-            self._file.write(json.dumps({"step": step, **clean}) + "\n")
-        if self._wandb:
-            self._wandb.log(clean, step=step)
-        parts = " ".join(
-            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
-            for k, v in clean.items()
-        )
-        print(f"[step {step}] {parts}", flush=True)
-
-    def event(self, name: str, step: int, **fields: Any) -> None:
-        """One-off run event (anomaly rollback, supervisor restart, watchdog
-        abort, skipped data shard) — lands in the same JSONL/wandb stream as
-        the scalar metrics so a post-mortem reads ONE timeline, but tagged
-        with ``event`` so dashboards can render it as an annotation instead
-        of a curve."""
-        if not self.enabled:
-            return
-        clean = {
-            k: (float(v) if hasattr(v, "item") else v) for k, v in fields.items()
-        }
-        if self._file:
-            self._file.write(
-                json.dumps({"step": step, "event": name, **clean}) + "\n"
-            )
-        if self._wandb:
-            self._wandb.log(
-                {f"event/{name}/{k}": v for k, v in clean.items()}, step=step
-            )
-        parts = " ".join(f"{k}={v}" for k, v in clean.items())
-        print(f"[step {step}] EVENT {name} {parts}", flush=True)
-
-    def close(self) -> None:
-        if self._file:
-            self._file.close()
-        if self._wandb:
-            self._wandb.finish()
-
-
-class StepTimer:
-    """Rolling wall-clock per-step timer. Call ``tick()`` once per step after
-    fetching a step output (the fetch is the device sync)."""
-
-    def __init__(self, window: int = 50):
-        self.window = window
-        self._times: list[float] = []
-        self._last: Optional[float] = None
-
-    def tick(self) -> Optional[float]:
-        now = time.perf_counter()
-        dt = None
-        if self._last is not None:
-            dt = now - self._last
-            self._times.append(dt)
-            if len(self._times) > self.window:
-                self._times.pop(0)
-        self._last = now
-        return dt
-
-    def mean(self) -> Optional[float]:
-        return sum(self._times) / len(self._times) if self._times else None
-
-
-@contextlib.contextmanager
-def profile(log_dir: str | Path, enabled: bool = True):
-    """Capture a jax.profiler trace viewable in TensorBoard/XProf."""
-    if not enabled:
-        yield
-        return
-    jax.profiler.start_trace(str(log_dir))
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = [
+    "TPU_PEAK_FLOPS",
+    "MetricsLogger",
+    "StepTimer",
+    "device_peak_flops",
+    "hbm_device_stats",
+    "hbm_used_gb",
+    "mfu",
+    "model_flops_per_token",
+    "profile",
+]
