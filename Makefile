@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos obs bench bench-watch serve-bench e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full chaos elastic-chaos serve-chaos obs bench bench-watch serve-bench train-bench e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -93,6 +93,23 @@ serve-bench:
 		$(PY) scripts/serve_bench_guard.py /tmp/_serve_cap_baseline.json BENCH_serve_capacity.json; \
 	else \
 		echo "serve-bench-guard: no committed capacity baseline; skipping"; \
+	fi
+
+# Training step-time decomposition lane (ISSUE 8): overlap-on/off A/B with
+# in-process BITWISE gradient parity, compute/exposed-comm split vs a
+# single-device baseline, the analytic bubble table (gpipe/1f1b/interleaved),
+# a measured tiny pipe run where the backend can execute it, the per-op
+# flash-vs-XLA attention microbench, and the assumption-labeled v5e
+# projection -> BENCH_step.json. The guard compares against the committed
+# artifact (parity must stay bitwise everywhere; timing/reduction graded on
+# matching hardware only). Schema pinned by tests/test_train_bench.py.
+train-bench:
+	@cp BENCH_step.json /tmp/_step_baseline.json 2>/dev/null || true
+	$(PY) scripts/train_step_bench.py
+	@if [ -f /tmp/_step_baseline.json ]; then \
+		$(PY) scripts/train_bench_guard.py /tmp/_step_baseline.json BENCH_step.json; \
+	else \
+		echo "train-bench-guard: no committed baseline; skipping"; \
 	fi
 
 # Retry the bench ladder until a live on-chip measurement lands, then promote

@@ -134,23 +134,33 @@ def child_train() -> dict:
     print(f"devices_ok platform={platform} n={jax.device_count()}", file=sys.stderr)
 
     loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0")) or None
+    # attention_impl A/B (ISSUE 8 satellite): "auto" (default) dispatches to
+    # the Pallas flash kernel on TPU; BENCH_ATTN_IMPL=xla pins the O(T^2)
+    # path so the pair of end-to-end runs prices the kernel in context
+    attn_impl = os.environ.get("BENCH_ATTN_IMPL", "auto")
     cfg = model_config(
         model_name, dropout=0.0, remat=remat, remat_policy=remat_policy,
-        loss_chunk=loss_chunk,
+        loss_chunk=loss_chunk, attention_impl=attn_impl,
     )
     n_chips = jax.device_count()
-    mesh = make_mesh(MeshConfig(zero_stage=1))
+    zero_stage = int(os.environ.get("BENCH_ZERO_STAGE", "1"))
+    # BENCH_OVERLAP=1: bucketed ZeRO comm overlap (parallel/overlap.py) —
+    # per-layer gathers/scatters inside the layer scan instead of the
+    # serial bracket; gradients bitwise-identical, only placement moves
+    overlap = os.environ.get("BENCH_OVERLAP", "0") == "1"
+    mesh = make_mesh(MeshConfig(zero_stage=zero_stage))
     model = Transformer(cfg)
     tx = make_optimizer(
         OptimizerConfig(warmup_steps=10, total_steps=1000, optimizer=optimizer)
     )
 
     sample_shape = (batch_size, seq)
-    plan = make_plan(model, tx, mesh, sample_shape, zero_stage=1)
+    plan = make_plan(model, tx, mesh, sample_shape, zero_stage=zero_stage)
     state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, sample_shape, plan)
     accum_dtype = os.environ.get("BENCH_ACCUM_DTYPE", "float32")
     step = make_train_step(
-        model, tx, mesh, plan, zero_stage=1, grad_accum_dtype=accum_dtype
+        model, tx, mesh, plan, zero_stage=zero_stage,
+        grad_accum_dtype=accum_dtype, overlap_comm=overlap,
     )
 
     batch = jax.random.randint(
@@ -200,6 +210,9 @@ def child_train() -> dict:
         "loss_chunk": loss_chunk,
         "grad_accum_dtype": accum_dtype,
         "optimizer": optimizer,
+        "attention_impl": attn_impl,
+        "zero_stage": zero_stage,
+        "overlap_comm": overlap,
         "n_chips": n_chips,
         "loss_finite": bool(loss == loss),
         "device_kind": jax.devices()[0].device_kind,
@@ -622,6 +635,21 @@ def main() -> None:
         ("remat_dots",
          {"BENCH_REMAT": "1", "BENCH_REMAT_POLICY": "dots",
           "BENCH_BATCH": "4", "BENCH_ACCUM": "16"}, upside_timeout),
+        # overlapped ZeRO comm (ISSUE 8): the same 580M headline config with
+        # zero_stage=2 serial vs overlapped collective placement — the pair
+        # prices the exposed-comm reduction end-to-end on real ICI (grads
+        # bitwise-identical between the arms, only placement moves). Run as
+        # a pair so neither number is orphaned by a mid-window wedge.
+        ("zero2_serial",
+         {"BENCH_REMAT": "1", "BENCH_ZERO_STAGE": "2"}, upside_timeout),
+        ("zero2_overlap",
+         {"BENCH_REMAT": "1", "BENCH_ZERO_STAGE": "2", "BENCH_OVERLAP": "1"},
+         upside_timeout),
+        # attention_impl A/B: same headline config pinned to the XLA O(T^2)
+        # attention — the flash kernel's end-to-end value at training shapes
+        # (the per-op sweep in child_flash prices it in isolation)
+        ("attn_xla",
+         {"BENCH_REMAT": "1", "BENCH_ATTN_IMPL": "xla"}, upside_timeout),
         ("remat_off", {"BENCH_REMAT": "0", "BENCH_BATCH": "4", "BENCH_ACCUM": "16"}, upside_timeout),
         # long-context training point: 580M at 8k tokens/row (the regime the
         # Pallas flash kernel + chunked CE exist for; same 64k tokens/step).

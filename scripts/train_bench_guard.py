@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Train-step-bench regression guard: fresh BENCH_step.json vs committed.
+
+``make train-bench`` snapshots the committed artifact before the run, then
+calls this with (baseline, fresh). Checks, in the style of
+``serve_bench_guard.py``:
+
+- **parity is platform-independent**: the fresh artifact's overlap-on /
+  overlap-off gradient parity must be BITWISE — a fast wrong step must
+  never pass the lane, anywhere;
+- on MATCHING hardware (platform + device kind):
+  - ``overlap_on.step_ms`` regressing > tolerance fails;
+  - the headline exposed-comm ``value`` (reduction, ×) shrinking past the
+    tolerance fails when both artifacts carry the same provenance
+    (measured vs projected numbers are never compared to each other).
+
+Skips exit 0 with a reason — the guard catches real regressions on
+comparable runs, not noise on incomparable ones.
+
+Usage: train_bench_guard.py <baseline.json> <fresh.json> [--tolerance 0.15]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+TOLERANCE = 0.15
+
+
+def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
+    """Returns (ok, messages). ok=True covers both pass and skip."""
+    msgs = []
+    ok = True
+
+    parity = fresh.get("parity", {})
+    if not parity.get("bitwise"):
+        return False, [
+            "REGRESSION: overlap-on/off gradient parity is no longer bitwise "
+            f"(parity={parity}) — the overlapped step changed the math, not "
+            "just the collective placement"
+        ]
+    msgs.append(f"ok: parity bitwise over {parity.get('steps')} step(s)")
+
+    base_hw = (baseline.get("platform"), baseline.get("device_kind"))
+    fresh_hw = (fresh.get("platform"), fresh.get("device_kind"))
+    if None in base_hw or None in fresh_hw:
+        return ok, msgs + ["SKIP: an artifact lacks platform/device_kind"]
+    if base_hw != fresh_hw:
+        return ok, msgs + [
+            f"SKIP: hardware mismatch (baseline {base_hw} vs fresh "
+            f"{fresh_hw}); timing not comparable"
+        ]
+
+    base_ms = baseline.get("overlap_on", {}).get("step_ms", 0)
+    fresh_ms = fresh.get("overlap_on", {}).get("step_ms", 0)
+    if not fresh_ms:
+        # a missing/zero measurement is a broken artifact, not a pass —
+        # the parity gate above proved the run got far enough to measure
+        return False, msgs + [
+            f"REGRESSION: fresh artifact has no overlap_on.step_ms "
+            f"({fresh.get('overlap_on')!r}) — bench did not complete"
+        ]
+    if base_ms and fresh_ms > base_ms * (1 + tolerance):
+        ok = False
+        msgs.append(
+            f"REGRESSION: overlap_on.step_ms {fresh_ms:.1f} > "
+            f"{(1 + tolerance) * 100:.0f}% of baseline {base_ms:.1f}"
+        )
+    else:
+        msgs.append(
+            f"ok: overlap_on.step_ms {fresh_ms:.1f} (baseline {base_ms:.1f})"
+        )
+
+    if baseline.get("provenance") == fresh.get("provenance"):
+        base_red = baseline.get("value", 0)
+        fresh_red = fresh.get("value", 0)
+        if base_red and fresh_red < base_red * (1 - tolerance):
+            ok = False
+            msgs.append(
+                f"REGRESSION: exposed-comm reduction {fresh_red:.2f}x < "
+                f"{(1 - tolerance) * 100:.0f}% of baseline {base_red:.2f}x "
+                f"({fresh.get('provenance')})"
+            )
+        else:
+            msgs.append(
+                f"ok: exposed-comm reduction {fresh_red:.2f}x "
+                f"(baseline {base_red:.2f}x, {fresh.get('provenance')})"
+            )
+    else:
+        msgs.append(
+            f"SKIP reduction: provenance changed "
+            f"({baseline.get('provenance')} -> {fresh.get('provenance')})"
+        )
+    return ok, msgs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("baseline", help="committed BENCH_step.json snapshot")
+    p.add_argument("fresh", help="artifact from the run under test")
+    p.add_argument("--tolerance", type=float, default=TOLERANCE)
+    args = p.parse_args(argv)
+    baseline = json.loads(Path(args.baseline).read_text())
+    fresh = json.loads(Path(args.fresh).read_text())
+    ok, msgs = compare(baseline, fresh, args.tolerance)
+    for m in msgs:
+        print(f"train-bench-guard: {m}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

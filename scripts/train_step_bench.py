@@ -1,0 +1,417 @@
+"""Step-time decomposition bench: compute / exposed-comm / bubble, A/B'd.
+
+Measures the training step's communication exposure with the ZeRO overlap
+on vs off (``parallel/overlap.py``) and writes ``BENCH_step.json``:
+
+- **overlap A/B**: the serial-placement step (one monolithic param gather,
+  one post-backward scatter sweep) vs the bucketed in-scan placement, same
+  math — gradients verified BITWISE between the arms in-process before any
+  timing is trusted (``parity.bitwise``);
+- **decomposition**: ``exposed_comm_ms = step_ms - compute_ms`` against a
+  single-device run doing the same PER-DEVICE work (identical local batch,
+  no collectives). On this repo's 2-core CPU container the 8 virtual
+  devices oversubscribe the cores, which inflates both arms' "comm" share
+  identically — the off/on RATIO keeps meaning there while the absolute
+  fractions do not transfer (same honesty discipline as
+  BENCH_ckpt_integrity.json);
+- **projection**: where the bench runs off-TPU, an assumption-labeled
+  model of the north-star config on v5e ICI (bytes/bandwidth vs
+  FLOPs/peak, per layer): serial placement exposes the FULL gather+scatter
+  time; overlapped placement exposes only the first gather, the last
+  scatter, and any per-layer comm that outruns per-layer compute. The
+  assumptions ride in the artifact so the number can be re-derived;
+- **bubble**: the analytic ``pipeline.bubble_fraction`` table for
+  gpipe/1f1b/interleaved at representative (P, M, V), plus a MEASURED tiny
+  pipe run when the backend can execute the pipe engine (this image's jax
+  0.4.37 cannot — the error is recorded verbatim rather than hidden);
+- **attention microbench** (ROADMAP 5(a) satellite): per-op flash-vs-XLA
+  fwd+bwd timings — the Pallas kernel is TPU-only, so on CPU the flash
+  column records why it did not run instead of a fake number.
+
+NOTE on platform: this image pre-imports jax, so JAX_PLATFORMS in the
+environment is ignored (see bench.py) — the script pins the backend via
+``jax.config`` from BENCH_PLATFORM (default cpu). On a TPU box run
+``BENCH_PLATFORM=tpu python scripts/train_step_bench.py``.
+
+Usage: python scripts/train_step_bench.py [--out BENCH_step.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# must precede backend init: the CPU arm needs an 8-device virtual mesh
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("BENCH_PLATFORM", "cpu"))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+# north-star projection assumptions (stated, not hidden — the projection is
+# only as honest as these numbers, so they ride in the artifact)
+V5E_ICI_GBPS = 400.0  # aggregate per-chip ICI bandwidth, GB/s
+V5E_PEAK_FLOPS = 197e12
+ASSUMED_MFU = 0.5  # matmul efficiency during the compute the comm hides under
+
+
+def _bench_model():
+    from zero_transformer_tpu.config import ModelConfig
+
+    # mid-sized: big enough that a step is tens of ms on this box and the
+    # per-layer buckets are real (8 layers), small enough to compile fast
+    return ModelConfig(
+        name="stepbench", vocab_size=1024, d_model=128, n_heads=4, n_layers=8,
+        max_seq_len=128, dropout=0.0, compute_dtype="float32",
+    )
+
+
+def _timed_steps(step, state, batch, rng, reps: int, inner: int):
+    """(best mean ms/step over ``reps`` windows of ``inner`` steps, state).
+    Sync via a scalar fetch (see bench.py: block_until_ready is not a
+    reliable barrier on every backend in this image)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            state, metrics = step(state, batch, rng)
+        float(metrics["loss"])
+        best = min(best, (time.perf_counter() - t0) / inner * 1e3)
+    return best, state
+
+
+def measure_overlap_ab(args) -> dict:
+    from zero_transformer_tpu.config import MeshConfig, OptimizerConfig
+    from zero_transformer_tpu.models import Transformer
+    from zero_transformer_tpu.parallel.mesh import make_mesh
+    from zero_transformer_tpu.parallel.zero import (
+        init_train_state, make_plan, make_train_step,
+    )
+    from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
+
+    cfg = _bench_model()
+    opt = OptimizerConfig(warmup_steps=10, total_steps=1000)
+    mesh = make_mesh(MeshConfig(zero_stage=args.zero_stage))
+    n_dev = jax.device_count()
+    model = Transformer(cfg)
+    tx = make_optimizer(opt)
+    B, T, accum = args.batch, args.seq, args.accum
+    plan = make_plan(model, tx, mesh, (B, T), args.zero_stage)
+    batch = jax.random.randint(
+        jax.random.PRNGKey(1), (accum, B, T), 0, cfg.vocab_size, jnp.int32
+    )
+    rng = jax.random.PRNGKey(2)
+
+    def build(overlap):
+        return make_train_step(
+            model, tx, mesh, plan, args.zero_stage, make_schedule(opt),
+            tx_factory=lambda nf, zc=None: make_optimizer(
+                opt, make_schedule(opt), nf, zero_collectives=zc
+            ),
+            overlap_comm=overlap,
+        )
+
+    def fresh():
+        return init_train_state(
+            model, tx, jax.random.PRNGKey(0), mesh, (B, T), plan
+        )
+
+    # ---- bitwise parity first: a fast wrong step must not win the A/B
+    s_on, s_off = fresh(), fresh()
+    step_on, step_off = build(True), build(False)
+    for i in range(2):
+        s_on, m_on = step_on(s_on, batch, rng)
+        s_off, m_off = step_off(s_off, batch, rng)
+    bitwise = float(m_on["loss"]) == float(m_off["loss"]) and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(s_on.params), jax.tree.leaves(s_off.params))
+    )
+
+    arms = {}
+    for name, step in (("overlap_off", step_off), ("overlap_on", step_on)):
+        state = fresh()
+        state, metrics = step(state, batch, rng)  # compile + warm
+        float(metrics["loss"])
+        ms, state = _timed_steps(step, state, batch, rng, args.reps, args.steps)
+        arms[name] = {"step_ms": round(ms, 3)}
+
+    # ---- compute baseline: 1 device, SAME per-device work, no collectives
+    mesh1 = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    local_B = max(B // n_dev, 1)
+    plan1 = make_plan(model, tx, mesh1, (local_B, T), 1)
+    step1 = make_train_step(model, tx, mesh1, plan1, 1, make_schedule(opt))
+    state1 = init_train_state(
+        model, tx, jax.random.PRNGKey(0), mesh1, (local_B, T), plan1
+    )
+    batch1 = batch[:, :local_B]
+    state1, m1 = step1(state1, batch1, rng)
+    float(m1["loss"])
+    compute_ms, _ = _timed_steps(step1, state1, batch1, rng, args.reps, args.steps)
+
+    for arm in arms.values():
+        exposed = max(0.0, arm["step_ms"] - compute_ms)
+        arm["exposed_comm_ms"] = round(exposed, 3)
+        arm["exposed_comm_frac"] = round(exposed / arm["step_ms"], 4)
+
+    off, on = arms["overlap_off"], arms["overlap_on"]
+    measured_reduction = (
+        round(off["exposed_comm_ms"] / on["exposed_comm_ms"], 2)
+        if on["exposed_comm_ms"] > 0
+        else None
+    )
+    return {
+        "mesh": {"data": n_dev},
+        "zero_stage": args.zero_stage,
+        "accum": accum,
+        "batch": B,
+        "seq": T,
+        "model_dims": {
+            "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+            "vocab": cfg.vocab_size,
+        },
+        "overlap_off": off,
+        "overlap_on": on,
+        "single_device_compute_ms": round(compute_ms, 3),
+        "measured_reduction": measured_reduction,
+        "parity": {"bitwise": bool(bitwise), "steps": 2},
+    }
+
+
+def projection_v5e_north_star() -> dict:
+    """Assumption-labeled exposed-comm projection for the 1.3B north-star
+    config on one v5e ICI domain of 8 chips, ZeRO stage 3 (FSDP), serial
+    vs overlapped placement. Every input is a field so the arithmetic can
+    be audited from the artifact alone."""
+    from zero_transformer_tpu.config import model_config
+
+    cfg = model_config("1_3b")
+    n_dev = 8
+    tokens_per_step = 64 * 1024  # the 64k-tokens/step bench discipline
+    embed = cfg.vocab_size * cfg.d_model
+    layer_params = (cfg.num_params - embed) / cfg.n_layers
+    bytes_per_param = 4  # f32 master params (what the ZeRO step moves)
+
+    # ring all-gather of one layer's params across 8 chips: each chip
+    # receives (N-1)/N of the full layer
+    layer_bytes = layer_params * bytes_per_param
+    t_gather_layer = layer_bytes * (n_dev - 1) / n_dev / (V5E_ICI_GBPS * 1e9)
+    t_scatter_layer = t_gather_layer  # reduce-scatter moves the same bytes
+    t_compute_layer = (
+        6.0 * layer_params * tokens_per_step / (V5E_PEAK_FLOPS * ASSUMED_MFU)
+    ) / n_dev
+
+    L = cfg.n_layers
+    serial_exposed = L * (t_gather_layer + t_scatter_layer)
+    # overlapped: the first gather and the last scatter have no compute to
+    # hide under; every other per-layer collective overlaps its neighbor
+    # layer's compute and is exposed only past that compute's duration
+    per_layer_exposed = max(0.0, t_gather_layer - t_compute_layer) + max(
+        0.0, t_scatter_layer - t_compute_layer
+    )
+    overlap_exposed = t_gather_layer + t_scatter_layer + (L - 1) * per_layer_exposed
+    step_compute = L * t_compute_layer
+    return {
+        "platform": "tpu_v5e_projected",
+        "model": "1_3b",
+        "n_devices": n_dev,
+        "tokens_per_step": tokens_per_step,
+        "assumptions": {
+            "ici_gbps": V5E_ICI_GBPS,
+            "peak_flops": V5E_PEAK_FLOPS,
+            "mfu_during_overlap": ASSUMED_MFU,
+            "bytes_per_param": bytes_per_param,
+        },
+        "per_layer_ms": {
+            "gather": round(t_gather_layer * 1e3, 3),
+            "scatter": round(t_scatter_layer * 1e3, 3),
+            "compute": round(t_compute_layer * 1e3, 3),
+        },
+        "serial_exposed_comm_frac": round(
+            serial_exposed / (step_compute + serial_exposed), 4
+        ),
+        "overlap_exposed_comm_frac": round(
+            overlap_exposed / (step_compute + overlap_exposed), 4
+        ),
+        "reduction": round(serial_exposed / max(overlap_exposed, 1e-12), 1),
+        "method": (
+            "ring-collective bytes/bandwidth vs per-layer matmul FLOPs/peak; "
+            "serial placement exposes all L gathers + L scatters, overlapped "
+            "placement exposes the first gather, the last scatter, and any "
+            "per-layer comm exceeding one layer's compute"
+        ),
+    }
+
+
+def bubble_table(args) -> dict:
+    from zero_transformer_tpu.parallel.pipeline import bubble_fraction
+
+    analytic = []
+    for sched, P_, M, V in (
+        ("gpipe", 4, 16, 1),
+        ("1f1b", 4, 16, 1),
+        ("interleaved", 4, 16, 2),
+        ("interleaved", 4, 16, 4),
+        ("gpipe", 8, 16, 1),
+        ("interleaved", 8, 16, 2),
+        ("interleaved", 8, 16, 4),
+    ):
+        analytic.append({
+            "pp_schedule": sched, "pipe": P_, "micro": M, "interleave": V,
+            "bubble_frac": round(bubble_fraction(sched, P_, M, V), 4),
+        })
+
+    measured = {}
+    for sched, V in (("gpipe", 1), ("interleaved", 2)):
+        try:
+            measured[sched] = _measure_pipe(sched, V, args)
+        except Exception as e:  # noqa: BLE001 — record, never hide
+            measured[sched] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"
+            }
+    return {"analytic": analytic, "measured": measured}
+
+
+def _measure_pipe(sched: str, interleave: int, args) -> dict:
+    from zero_transformer_tpu.config import MeshConfig, ModelConfig, OptimizerConfig
+    from zero_transformer_tpu.models import Transformer
+    from zero_transformer_tpu.parallel.mesh import make_mesh
+    from zero_transformer_tpu.parallel.zero import (
+        init_train_state, make_plan, make_train_step,
+    )
+    from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
+
+    cfg = ModelConfig(
+        name="ppbench", vocab_size=512, d_model=64, n_heads=4, n_layers=4,
+        max_seq_len=64, dropout=0.0, compute_dtype="float32",
+    )
+    opt = OptimizerConfig(warmup_steps=10, total_steps=1000)
+    mesh = make_mesh(MeshConfig(pipe=2, data=jax.device_count() // 2))
+    model = Transformer(cfg)
+    tx = make_optimizer(opt)
+    plan = make_plan(model, tx, mesh, (4, 32), 1, pp_schedule=sched)
+    state = init_train_state(model, tx, jax.random.PRNGKey(0), mesh, (4, 32), plan)
+    step = make_train_step(
+        model, tx, mesh, plan, 1, make_schedule(opt), pp_schedule=sched,
+        pp_interleave=interleave,
+    )
+    batch = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 4, 32), 0, cfg.vocab_size, jnp.int32
+    )
+    rng = jax.random.PRNGKey(2)
+    state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    ms, _ = _timed_steps(step, state, batch, rng, args.reps, args.steps)
+    return {"step_ms": round(ms, 3), "pipe": 2, "micro": 4,
+            "interleave": interleave}
+
+
+def attention_microbench(args) -> dict:
+    """Per-op flash-vs-XLA attention, fwd+bwd (ROADMAP 5(a)): the kernel is
+    Pallas/TPU — off TPU the flash column says WHY it is absent."""
+    from zero_transformer_tpu.ops import flash_attention as fa
+    from zero_transformer_tpu.ops.attention import xla_attention
+
+    points = []
+    for B, T in ((4, 128), (2, 256)):
+        H, D = 4, 64
+        q, k, v = (
+            jax.random.normal(jax.random.PRNGKey(i), (B, T, H, D), jnp.float32)
+            for i in range(3)
+        )
+
+        def bench(fn):
+            lossf = lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32))
+            step = jax.jit(jax.grad(lossf, argnums=(0, 1, 2)))
+            out = step(q, k, v)
+            float(jnp.sum(out[0]))
+            t0 = time.perf_counter()
+            for _ in range(args.reps * 2):
+                out = step(q, k, v)
+            float(jnp.sum(out[0]))
+            return (time.perf_counter() - t0) / (args.reps * 2) * 1e3
+
+        xla_ms = bench(
+            lambda q, k, v: xla_attention(q, k, v, causal=True, alibi=True)
+        )
+        point = {"shape": [B, T, H, D], "xla_ms": round(xla_ms, 3)}
+        if fa.supported(q, k, v, causal=True, alibi=True):
+            flash_ms = bench(
+                lambda q, k, v: fa.flash_attention(q, k, v, causal=True, alibi=True)
+            )
+            point["flash_ms"] = round(flash_ms, 3)
+            point["speedup"] = round(xla_ms / flash_ms, 2)
+        else:
+            point["flash_unsupported_reason"] = (
+                f"pallas TPU kernel; backend={jax.default_backend()}"
+            )
+        points.append(point)
+    return {"points": points, "impl_default": "auto (flash on TPU, xla elsewhere)"}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default="BENCH_step.json")
+    p.add_argument("--zero-stage", type=int, default=2)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--accum", type=int, default=2)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--steps", type=int, default=4, help="steps per timing window")
+    args = p.parse_args()
+
+    ab = measure_overlap_ab(args)
+    platform = jax.default_backend()
+    # always computed: on TPU it is the fallback headline when the
+    # overlapped arm's exposed comm measures 0 (measured_reduction None —
+    # "fully hidden" has no finite ratio), and off-TPU it IS the headline
+    projection = projection_v5e_north_star()
+
+    # headline value: the exposed-comm reduction — measured on TPU, the
+    # labeled projection elsewhere (a 2-core CPU's collective "time" is
+    # memcpy + core oversubscription and does not transfer)
+    if platform == "tpu" and ab["measured_reduction"]:
+        value, provenance = ab["measured_reduction"], "measured"
+    else:
+        value, provenance = projection["reduction"], "projected_v5e"
+
+    artifact = {
+        "metric": "train_step_exposed_comm_reduction",
+        "value": value,
+        "unit": "x",
+        "provenance": provenance,
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        **ab,
+        "projection": projection,
+        "bubble": bubble_table(args),
+        "attention_microbench": attention_microbench(args),
+        "note": (
+            "CPU-box caveat: the 8 'devices' are host threads on 2 shared "
+            "cores, so the measured exposed-comm fractions are dominated by "
+            "core oversubscription and do NOT transfer to TPU; the off/on "
+            "arms share that inflation, and the bitwise parity + projection "
+            "carry the honest claim (same methodology as "
+            "BENCH_ckpt_integrity.json)"
+        ) if platform != "tpu" else "measured on-chip",
+        "best_of": args.reps,
+        "measured_at_utc": datetime.now(timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"
+        ),
+    }
+    Path(args.out).write_text(json.dumps(artifact) + "\n")
+    print(json.dumps(artifact))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,251 @@
+"""Overlapped ZeRO communication (parallel/overlap.py): parity is the contract.
+
+The overlapped step moves WHERE the collectives sit (per-layer gathers and
+scatters inside the layer scan instead of one serial bracket) — it must not
+move WHAT is computed. These tests pin:
+
+- overlap-on ≡ overlap-off BITWISE at ZeRO-1 and ZeRO-2, including the
+  optimizer trajectory over multiple steps (the A/B arms the step bench
+  times share one core; a fast wrong arm must never win the A/B);
+- the overlapped step ≡ the legacy serial explicit core BITWISE at ZeRO-2
+  (same shard_map collective schedule, different placement only); ZeRO-1's
+  legacy step is a GSPMD program with a different reduction order, so the
+  cross-core pin there is allclose;
+- bucket derivation comes from the ShardingPlan (layer count, byte sizes,
+  the scan_layers requirement) — never a hand-list;
+- the config/build seams refuse the combinations the design excludes
+  (pipe meshes, ZeRO stage 0, unscanned layers) loudly.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.config import MeshConfig, ModelConfig, OptimizerConfig
+from zero_transformer_tpu.models import Transformer
+from zero_transformer_tpu.parallel import (
+    make_mesh,
+    make_plan,
+    init_train_state,
+    make_train_step,
+)
+from zero_transformer_tpu.parallel.overlap import (
+    bucket_summary,
+    derive_buckets,
+    make_overlap_zero_step,
+)
+from zero_transformer_tpu.training.optimizer import make_optimizer, make_schedule
+
+CFG = ModelConfig(
+    name="t", vocab_size=256, d_model=64, n_heads=4, n_layers=2, max_seq_len=32,
+    dropout=0.0, compute_dtype="float32",
+)
+OPT = OptimizerConfig(peak_learning_rate=1e-3, warmup_steps=4, total_steps=64)
+
+
+def _setup(zero_stage, model_cfg=CFG):
+    mesh = make_mesh(MeshConfig(zero_stage=zero_stage))
+    model = Transformer(model_cfg)
+    tx = make_optimizer(OPT)
+    plan = make_plan(model, tx, mesh, (2, 16), zero_stage)
+    return mesh, model, tx, plan
+
+
+def _fresh(model, tx, mesh, plan):
+    return init_train_state(model, tx, jax.random.PRNGKey(0), mesh, (2, 16), plan)
+
+
+def _batch(accum=2, bs=8, T=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, CFG.vocab_size, (accum, bs, T)), jnp.int32)
+
+
+def _params_bitwise(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("zero_stage", [1, 2])
+def test_overlap_on_off_bitwise(devices, zero_stage):
+    """The A/B arms: identical compute, collective placement the only
+    difference — params bitwise after a 2-step optimizer trajectory."""
+    mesh, model, tx, plan = _setup(zero_stage)
+    rng = jax.random.PRNGKey(7)
+    states, losses = {}, {}
+    for overlap in (False, True):
+        step = make_overlap_zero_step(
+            model, tx, mesh, plan, zero_stage, make_schedule(OPT),
+            overlap=overlap,
+        )
+        state = _fresh(model, tx, mesh, plan)
+        for i in range(2):
+            state, metrics = step(state, _batch(seed=i), rng)
+        states[overlap], losses[overlap] = state, float(metrics["loss"])
+    assert losses[True] == losses[False]
+    _params_bitwise(states[True].params, states[False].params)
+    _params_bitwise(states[True].opt_state, states[False].opt_state)
+
+
+def test_overlap_matches_legacy_serial_core_zero2(devices):
+    """make_train_step(overlap_comm=True) vs the legacy ZeRO-2 explicit
+    core: same shard_map collective schedule, so bitwise, trajectory
+    included."""
+    mesh, model, tx, plan = _setup(2)
+    rng = jax.random.PRNGKey(7)
+    results = {}
+    for overlap in (False, True):
+        step = make_train_step(
+            model, tx, mesh, plan, 2, make_schedule(OPT), overlap_comm=overlap
+        )
+        state = _fresh(model, tx, mesh, plan)
+        for i in range(3):
+            state, metrics = step(state, _batch(seed=i), rng)
+        results[overlap] = (state, float(metrics["loss"]))
+    assert results[True][1] == results[False][1]
+    _params_bitwise(results[True][0].params, results[False][0].params)
+
+
+def test_overlap_close_to_legacy_gspmd_zero1(devices):
+    """ZeRO-1's legacy step is a GSPMD program (pmean all-reduce) — a
+    different reduction order from the overlap core's reduce-scatter +
+    gather, so the pin is allclose, not bitwise."""
+    mesh, model, tx, plan = _setup(1)
+    rng = jax.random.PRNGKey(7)
+    results = {}
+    for overlap in (False, True):
+        step = make_train_step(
+            model, tx, mesh, plan, 1, make_schedule(OPT), overlap_comm=overlap
+        )
+        state = _fresh(model, tx, mesh, plan)
+        for i in range(2):
+            state, metrics = step(state, _batch(seed=i), rng)
+        results[overlap] = (state, float(metrics["loss"]))
+    np.testing.assert_allclose(results[True][1], results[False][1], rtol=2e-5)
+    for a, b in zip(
+        jax.tree.leaves(results[True][0].params),
+        jax.tree.leaves(results[False][0].params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-6)
+
+
+@pytest.mark.slow
+def test_overlap_with_remat_bitwise(devices):
+    """Under remat the gather sits inside the checkpointed region (backward
+    re-gathers); placement still must not change the math."""
+    cfg = dataclasses.replace(CFG, remat=True)
+    mesh, model, tx, plan = _setup(2, model_cfg=cfg)
+    rng = jax.random.PRNGKey(7)
+    states = {}
+    for overlap in (False, True):
+        step = make_overlap_zero_step(
+            model, tx, mesh, plan, 2, make_schedule(OPT), overlap=overlap
+        )
+        state = _fresh(model, tx, mesh, plan)
+        state, _ = step(state, _batch(), rng)
+        states[overlap] = state
+    _params_bitwise(states[True].params, states[False].params)
+
+
+def test_overlap_learns(devices):
+    mesh, model, tx, plan = _setup(2)
+    step = make_train_step(
+        model, tx, mesh, plan, 2, make_schedule(OPT), overlap_comm=True
+    )
+    state = _fresh(model, tx, mesh, plan)
+    rng = jax.random.PRNGKey(42)
+    losses = []
+    for _ in range(15):
+        state, metrics = step(state, _batch(accum=1, seed=0), rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.5, f"no learning: {losses}"
+
+
+def test_bucket_derivation_from_plan(devices):
+    """Buckets come from the plan's logical specs: one per layer + dense."""
+    mesh, model, tx, plan = _setup(2)
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32)),
+        jax.random.PRNGKey(0),
+    )["params"]
+    from zero_transformer_tpu.parallel.sharding import unbox
+
+    abstract = unbox(abstract)
+    b = derive_buckets(plan, mesh, abstract)
+    assert b.n_layers == CFG.n_layers
+    assert b.n_buckets == CFG.n_layers + 1
+    blocks_bytes = sum(
+        leaf.size * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree.leaves(abstract["blocks"])
+    )
+    assert b.layer_bucket_bytes == blocks_bytes // CFG.n_layers
+    summary = bucket_summary(plan, mesh, abstract)
+    assert summary["n_layer_buckets"] == CFG.n_layers
+    # two gathered layers live during the telescoping prefetch
+    assert summary["overlap_gather_buffer_bytes"] == 2 * b.layer_bucket_bytes
+
+
+def test_overlap_requires_scan_layers(devices):
+    cfg = dataclasses.replace(CFG, scan_layers=False)
+    mesh, model, tx, plan = _setup(2, model_cfg=cfg)
+    with pytest.raises(ValueError, match="scan_layers"):
+        make_overlap_zero_step(model, tx, mesh, plan, 2)
+
+
+def test_overlap_config_validation():
+    with pytest.raises(ValueError, match="overlap_comm"):
+        MeshConfig(overlap_comm=True, pipe=2, data=4)
+    with pytest.raises(ValueError, match="zero_stage"):
+        MeshConfig(overlap_comm=True, zero_stage=0)
+    # valid combination constructs
+    MeshConfig(overlap_comm=True, zero_stage=2)
+
+
+def test_overlap_build_rejects_stage0_and_pipe(devices):
+    mesh, model, tx, plan = _setup(0)
+    with pytest.raises(ValueError, match="zero_stage"):
+        make_train_step(
+            model, tx, mesh, plan, 0, make_schedule(OPT), overlap_comm=True
+        )
+    mesh_pp = make_mesh(MeshConfig(pipe=2, data=4))
+    model_pp = Transformer(dataclasses.replace(CFG, n_layers=4))
+    tx_pp = make_optimizer(OPT)
+    plan_pp = make_plan(model_pp, tx_pp, mesh_pp, (2, 16), 1)
+    with pytest.raises(ValueError, match="pipe"):
+        make_train_step(
+            model_pp, tx_pp, mesh_pp, plan_pp, 1, make_schedule(OPT),
+            overlap_comm=True,
+        )
+
+
+def test_overlap_psums_indivisible_leaves(devices):
+    """Leaves with no dim divisible by the ZeRO world (d_model=68 on 8
+    devices: ln scales, attention kernels) are stored replicated and get no
+    gather — so autodiff gives their grads no collective. The overlap core
+    must psum them explicitly (as the serial core's reduce_grads does) or
+    replicas silently diverge; pinned bitwise against the legacy serial
+    core, which handles them correctly."""
+    cfg = dataclasses.replace(CFG, d_model=68, n_heads=4)
+    mesh, model, tx, plan = _setup(2, model_cfg=cfg)
+    from zero_transformer_tpu.parallel.mesh import zero_axes
+    from zero_transformer_tpu.parallel.zero import _zero_scatter_dim
+
+    sdims = jax.tree.map(
+        lambda ns: _zero_scatter_dim(ns.spec, zero_axes(mesh)), plan.zero
+    )
+    assert any(d < 0 for d in jax.tree.leaves(sdims)), (
+        "test premise broken: no ZeRO-replicated leaf in this model"
+    )
+    rng = jax.random.PRNGKey(7)
+    results = {}
+    for overlap in (False, True):
+        step = make_train_step(
+            model, tx, mesh, plan, 2, make_schedule(OPT), overlap_comm=overlap
+        )
+        state = _fresh(model, tx, mesh, plan)
+        for i in range(2):
+            state, metrics = step(state, _batch(seed=i), rng)
+        results[overlap] = (state, float(metrics["loss"]))
+    assert results[True][1] == results[False][1]
+    _params_bitwise(results[True][0].params, results[False][0].params)

@@ -85,6 +85,17 @@ def main():
     parser.add_argument("--wandb", action="store_true", default=False)
     parser.add_argument("--max-steps", type=int, default=None)
     parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        metavar="PORT",
+        help="serve the training Prometheus registry at "
+        "http://0.0.0.0:PORT/metrics (train_bubble_frac, "
+        "train_exposed_comm_frac, ...); 0 disables. Pair with "
+        "training.step_bench_artifact pointing at a BENCH_step.json "
+        "measured on this platform to populate the exposed-comm gauge",
+    )
+    parser.add_argument(
         "--profile",
         type=int,
         default=None,
@@ -186,12 +197,30 @@ def main():
     if args.supervise:
         from zero_transformer_tpu.resilience import Supervisor
 
+        if args.metrics_port:
+            # loud, not silent: the supervisor rebuilds the Trainer (and its
+            # registry) on every restart, so a single exporter bound here
+            # would scrape a dead registry after the first recovery
+            logging.getLogger("zero_transformer_tpu").warning(
+                "--metrics-port is not supported with --supervise "
+                "(the trainer registry is rebuilt across restarts); "
+                "no /metrics endpoint will be served"
+            )
         Supervisor(cfg, use_wandb=args.wandb).run(max_steps=args.max_steps)
         return
     trainer = Trainer(cfg, use_wandb=args.wandb)
+    exporter = None
     try:
+        if args.metrics_port:
+            # inside the try: a bind failure (port in use) must still close
+            # the trainer's async checkpoint machinery on the way out
+            from zero_transformer_tpu.obs import MetricsExporter
+
+            exporter = MetricsExporter(trainer.registry, port=args.metrics_port)
         trainer.train(max_steps=args.max_steps)
     finally:
+        if exporter is not None:
+            exporter.close()
         trainer.close()
 
 

@@ -219,22 +219,71 @@ class MeshConfig:
     # pipeline schedule (pipe > 1): "gpipe" = fill-drain wavefront, activation
     # stash O(M) microbatches; "1f1b" = one-forward-one-backward ticks with
     # stash-and-recompute, activation stash O(P) — use when M (accumulation
-    # depth) at the target context no longer fits HBM. See docs/DESIGN.md.
+    # depth) at the target context no longer fits HBM; "interleaved" = V
+    # virtual stages per rank (`pp_interleave`) shrinking the bubble from
+    # (P-1)/(M+P-1) toward (P-1)/(V*M+P-1) — use when the bubble, not HBM,
+    # dominates step time. See docs/TRAINING.md.
     pp_schedule: str = "gpipe"
+    # virtual pipeline stages per rank for pp_schedule="interleaved": each
+    # microbatch makes V laps around the pipe ring, each lap running
+    # n_layers/(pipe*V) layers per rank. Requires n_layers % (pipe*V) == 0
+    # and accumulation depth M % pipe == 0 (microbatches flow in groups of
+    # P so the wrap-around hop arrives exactly when needed — no stash).
+    pp_interleave: int = 1
+    # Overlapped ZeRO communication (parallel/overlap.py): the train step is
+    # built around layer-granular comm buckets derived from the sharding
+    # plan — the per-layer param all_gather and gradient psum_scatter are
+    # issued INSIDE the blocks' layer scan (gather for layer l as its
+    # iteration starts, scatter for layer l as its backward retires), so
+    # XLA's latency-hiding scheduler can hide the collectives behind
+    # adjacent layers' compute instead of exposing one monolithic
+    # gather/scatter bracket around the whole step. Gradients are
+    # bit-identical to the serial placement (tests/test_overlap.py).
+    # Requires zero_stage >= 1, scan_layers, and no pipe axis (the pipeline
+    # engine owns its own collective schedule).
+    overlap_comm: bool = False
 
     def __post_init__(self):
         if self.dcn_data < 1:
             raise ValueError(f"dcn_data must be >= 1, got {self.dcn_data}")
-        if self.pp_schedule not in ("gpipe", "1f1b"):
+        if self.pp_schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"pp_schedule must be 'gpipe' or '1f1b', got {self.pp_schedule!r}"
+                f"pp_schedule must be 'gpipe', '1f1b', or 'interleaved', "
+                f"got {self.pp_schedule!r}"
             )
         if self.pp_schedule != "gpipe" and self.pipe == 1:
             # loud, not silent: without a pipe axis the schedule choice
             # would be ignored while the user expects 1F1B's O(P) memory
+            # or interleaved's smaller bubble
             raise ValueError(
                 f"pp_schedule={self.pp_schedule!r} requires pipe > 1 "
                 f"(got pipe={self.pipe})"
+            )
+        if self.pp_interleave < 1:
+            raise ValueError(
+                f"pp_interleave must be >= 1, got {self.pp_interleave}"
+            )
+        if self.pp_interleave > 1 and self.pp_schedule != "interleaved":
+            raise ValueError(
+                f"pp_interleave={self.pp_interleave} only applies to "
+                f"pp_schedule='interleaved' (got {self.pp_schedule!r})"
+            )
+        if self.pp_schedule == "interleaved" and self.pp_interleave < 2:
+            raise ValueError(
+                "pp_schedule='interleaved' needs pp_interleave >= 2 virtual "
+                "stages per rank (pp_interleave=1 is exactly gpipe — ask "
+                "for that by name)"
+            )
+        if self.overlap_comm and self.pipe > 1:
+            raise ValueError(
+                "overlap_comm applies to the non-pipeline ZeRO step; the "
+                "pipeline engine owns its own collective schedule "
+                "(pp_schedule) — drop one of overlap_comm / pipe > 1"
+            )
+        if self.overlap_comm and self.zero_stage < 1:
+            raise ValueError(
+                "overlap_comm requires zero_stage >= 1: at stage 0 there "
+                "is no ZeRO collective schedule to overlap"
             )
 
 
@@ -292,6 +341,14 @@ class TrainingConfig:
     # per-tensor normalization makes it insensitive to that scale of noise.
     # float32 is the default and is bit-identical to the pre-knob behavior.
     grad_accum_dtype: str = "float32"
+    # path to a BENCH_step.json step-time decomposition artifact
+    # (scripts/train_step_bench.py) measured for this config's platform.
+    # When set, the trainer's obs track reports train/exposed_comm_frac
+    # from the artifact's measured overlap A/B alongside the analytic
+    # train/bubble_frac gauge, and emits per-window grads_compute /
+    # comm_exposed / bubble_wait estimate spans. "" = bubble_frac only
+    # (it is analytic — exact for the configured schedule).
+    step_bench_artifact: str = ""
 
     def __post_init__(self):
         if self.grad_accum_dtype not in ("float32", "bfloat16"):

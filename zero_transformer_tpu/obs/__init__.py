@@ -24,6 +24,7 @@ resilience escalation seams:
 See docs/OBSERVABILITY.md for the span model, metric tables, and scrape
 configuration.
 """
+from zero_transformer_tpu.obs.exporter import MetricsExporter
 from zero_transformer_tpu.obs.flight import FlightRecorder
 from zero_transformer_tpu.obs.logging import (
     MetricsLogger,
@@ -55,6 +56,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "LATENCY_BUCKETS",
+    "MetricsExporter",
     "MetricsLogger",
     "ProfileWindow",
     "Registry",

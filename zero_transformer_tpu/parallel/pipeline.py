@@ -19,7 +19,12 @@ Beyond the reference (SURVEY §2 checklist: PP = none). TPU-first design:
   divergent control flow, one compiled program (SPMD).
 
 The bubble fraction is the textbook (P-1)/(M+P-1): gradient-accumulation
-microbatches ARE the pipeline microbatches.
+microbatches ARE the pipeline microbatches. ``pp_schedule="interleaved"``
+(``core_interleaved``) shrinks it toward (P-1)/(V*M+P-1): each rank runs V
+virtual stages of n_layers/(P*V) layers and every microbatch makes V laps
+around the ring, so the fill/drain ramps are paid in stage units V× smaller
+(arXiv:2412.14374's collectives-off-the-critical-path direction, on the
+same stage_slot single-source stage forward as GPipe and 1F1B).
 """
 from __future__ import annotations
 
@@ -43,6 +48,61 @@ def _pipe_part(spec: P) -> P:
     """Keep only the ``pipe`` entries of a spec (manual axis); every other
     axis stays auto under the partial-manual shard_map."""
     return restrict_spec(spec, {PIPE_AXIS})
+
+
+def interleaved_slot(t, rank, n_stages: int, interleave: int, n_micro: int):
+    """What (rank, tick) works on under the interleaved schedule — the ONE
+    index arithmetic shared by ``core_interleaved`` (traced values) and the
+    dataflow simulation test (concrete ints), so the schedule the tests
+    prove is the schedule the engine runs.
+
+    Items flow in groups of P microbatches through V chunk-laps: item
+    j = t - rank decodes as (group, chunk v, lane) = (j // (V*P),
+    (j % (V*P)) // P, j % P), microbatch = group*P + lane, global stage
+    (the layer-chunk id) = v*P + rank. Returns
+    ``(valid, mb, v, chunk, first, final)`` where ``first`` marks the
+    embedding stage (rank 0, lap 0) and ``final`` the loss stage
+    (last rank, last lap).
+    """
+    V, P_, M = interleave, n_stages, n_micro
+    j = t - rank
+    jc = jnp.clip(j, 0, V * M - 1)
+    g, rem = jc // (V * P_), jc % (V * P_)
+    v, lane = rem // P_, rem % P_
+    mb = g * P_ + lane
+    chunk = v * P_ + rank
+    valid = (j >= 0) & (j < V * M)
+    first = (rank == 0) & (v == 0)
+    final = (rank == P_ - 1) & (v == V - 1)
+    return valid, mb, v, chunk, first, final
+
+
+def bubble_fraction(
+    pp_schedule: str, n_stages: int, n_micro: int, interleave: int = 1
+) -> float:
+    """Idle fraction of the pipeline wavefront for a schedule — the ONE
+    analytic formula shared by the trainer's ``train/bubble_frac`` gauge,
+    ``memory_analysis``, and the step bench (they must never disagree).
+
+    gpipe: (P-1)/(M+P-1) — fill + drain in full-stage units.
+    1f1b: (2P-2)/(M+2P-2) — its unified fwd+bwd ticks pay both ramps
+      (the schedule trades bubble for the O(P) stash, not the reverse).
+    interleaved: (P-1)/(V*M+P-1) — V virtual stages per rank make the
+      ramp units V× smaller.
+    """
+    if pp_schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(
+            f"pp_schedule must be 'gpipe', '1f1b', or 'interleaved', "
+            f"got {pp_schedule!r}"
+        )
+    P_, M, V = n_stages, max(n_micro, 1), max(interleave, 1)
+    if P_ <= 1:
+        return 0.0
+    if pp_schedule == "1f1b":
+        return (2 * P_ - 2) / (M + 2 * P_ - 2)
+    if pp_schedule == "interleaved":
+        return (P_ - 1) / (V * M + P_ - 1)
+    return (P_ - 1) / (M + P_ - 1)
 
 
 def _has_pipe(spec: P) -> bool:
@@ -86,6 +146,7 @@ def make_pp_train_step(
     tx_factory: Optional[Callable] = None,
     pp_schedule: str = "gpipe",
     grad_accum_dtype: str = "float32",
+    pp_interleave: int = 1,
 ) -> Callable:
     """Fused train step for meshes with an active ``pipe`` axis.
 
@@ -130,20 +191,56 @@ def make_pp_train_step(
 
     cfg = model.cfg
     n_stages = mesh.shape[PIPE_AXIS]
-    if pp_schedule not in ("gpipe", "1f1b"):
+    if pp_schedule not in ("gpipe", "1f1b", "interleaved"):
         # validate at the API boundary too (MeshConfig validates its own
         # field, but direct callers bypass it) — a typo must not silently
         # build the gpipe schedule while the user expects 1F1B's O(P) memory
+        # or interleaved's smaller bubble
         raise ValueError(
-            f"pp_schedule must be 'gpipe' or '1f1b', got {pp_schedule!r}"
+            f"pp_schedule must be 'gpipe', '1f1b', or 'interleaved', "
+            f"got {pp_schedule!r}"
         )
     acc_dt = _accum_dtype(grad_accum_dtype)
     if acc_dt != jnp.float32 and pp_schedule != "1f1b":
         raise NotImplementedError(
             "grad_accum_dtype=bfloat16 requires pp_schedule='1f1b' (its "
-            "gradient accumulator is a hand-placed scan carry; GPipe's lives "
-            "inside jax's scan-VJP machinery, which follows the param dtype) "
-            "— and 1F1B is the memory-starved regime the knob exists for"
+            "gradient accumulator is a hand-placed scan carry; GPipe's and "
+            "interleaved's live inside jax's scan-VJP machinery, which "
+            "follows the param dtype) — and 1F1B is the memory-starved "
+            "regime the knob exists for"
+        )
+    interleave = pp_interleave if pp_schedule == "interleaved" else 1
+    if pp_schedule == "interleaved" and pp_interleave < 2:
+        raise ValueError(
+            "pp_schedule='interleaved' needs pp_interleave >= 2 (1 virtual "
+            "stage per rank is exactly gpipe — ask for that by name)"
+        )
+    if pp_schedule != "interleaved" and pp_interleave > 1:
+        raise ValueError(
+            f"pp_interleave={pp_interleave} only applies to "
+            f"pp_schedule='interleaved'"
+        )
+    blocks_pipe_sharded = any(
+        jax.tree.leaves(
+            jax.tree.map(
+                lambda ns: _has_pipe(ns.spec), plan.state.params["blocks"]
+            )
+        )
+    )
+    if pp_schedule == "interleaved" and blocks_pipe_sharded:
+        raise ValueError(
+            "interleaved schedule needs the block stack stored "
+            "pipe-REPLICATED (virtual stage v of rank r runs layers "
+            "[(v*P+r)*Lc, ...) — a round-robin set no contiguous pipe shard "
+            "can hold); build the plan with make_plan(..., "
+            "pp_schedule='interleaved')"
+        )
+    if pp_schedule != "interleaved" and not blocks_pipe_sharded:
+        raise ValueError(
+            f"plan stores the block stack pipe-replicated (an interleaved "
+            f"plan) but pp_schedule={pp_schedule!r} expects contiguous "
+            f"pipe-sharded stages; rebuild the plan with the matching "
+            f"pp_schedule"
         )
     if zero_stage >= 3:
         raise NotImplementedError(
@@ -201,34 +298,43 @@ def make_pp_train_step(
         block_cls = nn.remat(
             Block, prevent_cse=False, policy=resolve_remat_policy(cfg)
         )
-    stage_mod = nn.scan(
-        block_cls,
-        variable_axes={"params": 0},
-        split_rngs={"params": True, "dropout": True},
-        length=l_local,
-        metadata_params={nn.PARTITION_NAME: "layers"},
-    )(cfg, False, False, None, None)  # deterministic=False: train step
+    def _make_stage_mod(length):
+        return nn.scan(
+            block_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=length,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, False, False, None, None)  # deterministic=False: train step
 
-    def stage_slot(p, x, mb, batch, rng, rank):
+    stage_mod = _make_stage_mod(l_local)
+
+    def stage_slot(p, blocks_p, smod, x, mb, batch, rng, first, fold):
         """THE per-rank stage forward — single source for every schedule
-        (GPipe ticks, both 1F1B slots, and through them the ZeRO-2 core).
-        Returns ``(h_out, (loss, aux))`` for microbatch ``mb`` given inbox
-        activation ``x``. Rank-dependent work is where-masked (embed feeds
-        h_in only on rank 0; the head+loss value is only meaningful where
-        the caller masks it for the last rank) — SPMD, one compiled body.
+        (GPipe ticks, both 1F1B slots, the interleaved laps, and through
+        them the ZeRO-2 core). Returns ``(h_out, (loss, aux))`` for
+        microbatch ``mb`` given inbox activation ``x``; ``blocks_p`` is the
+        stacked params this slot's layers run on (the rank's contiguous
+        stage for GPipe/1F1B, one dynamically sliced virtual chunk for
+        interleaved) applied through ``smod`` (an nn.scan of the matching
+        length). Rank-dependent work is where-masked (embed feeds h_in only
+        where ``first``; the head+loss value is only meaningful where the
+        caller masks it for the final stage) — SPMD, one compiled body.
+        ``fold`` keys the dropout rng (the global stage id: rank for
+        contiguous schedules, v*P+rank for interleaved — identical at V=1).
         Every rank holds the full pipe-replicated batch, so packed-document
         ids are re-derived locally with the ONE shared rule
         (models/gpt.py doc_ids_from_tokens) instead of riding the hops."""
         M = batch.shape[0]
         tokens = batch[jnp.clip(mb, 0, M - 1)]
         emb = embed_mod.apply({"params": p["wte"]}, tokens)
-        h_in = jnp.where(rank == 0, emb, x)
-        mrng = jax.random.fold_in(jax.random.fold_in(rng, mb), rank)
+        h_in = jnp.where(first, emb, x)
+        mrng = jax.random.fold_in(jax.random.fold_in(rng, mb), fold)
         carry_in = (h_in.astype(dtype), jnp.zeros((), jnp.float32))
         if packed:
             carry_in = carry_in + (doc_ids_from_tokens(tokens, cfg.doc_sep_token),)
-        (h_out, aux, *_), _ = stage_mod.apply(
-            {"params": p["blocks"]}, carry_in, rngs={"dropout": mrng}
+        (h_out, aux, *_), _ = smod.apply(
+            {"params": blocks_p}, carry_in, rngs={"dropout": mrng}
         )
         h_norm = norm_mod.apply({"params": p["ln_f"]}, h_out)
         labels = tokens
@@ -284,7 +390,10 @@ def make_pp_train_step(
                 [(i, (i + 1) % n_stages) for i in range(n_stages)],
             )
             mb = t - rank  # microbatch this rank works on at tick t
-            h_out, (loss_t, aux) = stage_slot(params, inbox, mb, batch, rng, rank)
+            h_out, (loss_t, aux) = stage_slot(
+                params, params["blocks"], stage_mod, inbox, mb, batch, rng,
+                rank == 0, rank,
+            )
             # only the last rank's loss counts, and there mb IS the
             # microbatch finishing at the tail (mb = t - (P-1) = mb_done)
             is_last = rank == n_stages - 1
@@ -329,7 +438,9 @@ def make_pp_train_step(
         S = 2 * n_stages  # ring slots; in-flight span is 2(P-1-r) < S
 
         def fwd_fn(p, x, mb):
-            return stage_slot(p, x, mb, batch, rng, rank)
+            return stage_slot(
+                p, p["blocks"], stage_mod, x, mb, batch, rng, rank == 0, rank
+            )
 
         fwd_ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         bwd_ring = [(i, (i - 1) % n_stages) for i in range(n_stages)]
@@ -390,6 +501,87 @@ def make_pp_train_step(
         grads = _psum_pipe_replicated(grads, _pipe_sharded_map(plan))
         return loss, grads
 
+    # ---------------------------------------------- interleaved schedule
+    # V virtual stages per rank: global stage s = v*P + r runs layers
+    # [s*Lc, (s+1)*Lc) with Lc = L/(P*V); every microbatch makes V laps
+    # around the ring, so the fill/drain ramps are paid in Lc-layer units —
+    # bubble (P-1)/(V*M+P-1) vs GPipe's (P-1)/(M+P-1). Microbatches flow in
+    # GROUPS OF P (Megatron's constraint, M % P == 0): item j of the tick
+    # sequence decodes as (group g, chunk v, lane i) = (j // (V*P),
+    # (j % (V*P)) // P, j % P), microbatch m = g*P + i — ordered so the
+    # wrap-around hop (rank P-1 finishing chunk v of m) arrives at rank 0
+    # EXACTLY when chunk v+1 of m starts: no activation stash, the inbox is
+    # always the live input. The block stack is pipe-REPLICATED (see
+    # make_plan's interleaved rules); each tick dynamic-slices its chunk,
+    # and chunk grads come back as disjoint per-rank partials summed by the
+    # pipe psum that already covers wte/ln_f/head. Memory trade vs GPipe:
+    # P× block-param storage, and the grad-through-scan stash grows with
+    # the tick count (V*M+P-1 vs M+P-1 carries) — this is interleaved
+    # GPipe, aimed at the bubble-bound regime, not the HBM-bound one
+    # (that's 1F1B's job). See docs/TRAINING.md.
+    l_chunk = cfg.n_layers // (n_stages * interleave) if interleave > 1 else l_local
+    if interleave > 1 and cfg.n_layers % (n_stages * interleave):
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by "
+            f"pipe*pp_interleave={n_stages * interleave}"
+        )
+    chunk_mod = _make_stage_mod(l_chunk) if interleave > 1 else stage_mod
+
+    def core_interleaved(params, batch, rng, reduce=True):
+        """Interleaved wavefront loss; same contract as ``core`` (GPipe),
+        including the rank-LOCAL ``reduce=False`` form the ZeRO-2 manual
+        region needs (see the GPipe wavefront docstring for why local)."""
+        rank = jax.lax.axis_index(PIPE_AXIS)
+        V = interleave
+        M = batch.shape[0]
+        if M % n_stages:
+            raise ValueError(
+                f"interleaved schedule needs microbatches (accum steps) "
+                f"divisible by pipe: M={M}, pipe={n_stages} — groups of P "
+                f"keep the wrap-around hop just-in-time"
+            )
+        n_ticks = V * M + n_stages - 1
+
+        def tick(carry, t):
+            outbox, loss_sum, aux_sum = carry
+            inbox = jax.lax.ppermute(
+                outbox,
+                PIPE_AXIS,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            valid, mb, v, chunk, first, is_final = interleaved_slot(
+                t, rank, n_stages, V, M
+            )
+            blocks_p = jax.tree.map(
+                lambda x: jax.lax.dynamic_slice_in_dim(
+                    x, chunk * l_chunk, l_chunk, axis=0
+                ),
+                params["blocks"],
+            )
+            h_out, (loss_t, aux) = stage_slot(
+                params, blocks_p, chunk_mod, inbox, mb, batch, rng, first,
+                chunk,
+            )
+            loss_sum = loss_sum + jnp.where(valid & is_final, loss_t, 0.0)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+            return (h_out, loss_sum, aux_sum), None
+
+        h0 = jnp.zeros((batch.shape[1], batch.shape[2], cfg.d_model), dtype)
+        (_, loss_sum, aux_sum), _ = jax.lax.scan(
+            tick,
+            (h0.astype(dtype), jnp.zeros((), jnp.float32),
+             jnp.zeros((), jnp.float32)),
+            jnp.arange(n_ticks),
+        )
+        local = loss_sum / M
+        if cfg.n_experts > 0:
+            local = local + aux_sum / M
+        if not reduce:
+            return local
+        return jax.lax.psum(local, PIPE_AXIS)
+
+    wavefront = core_interleaved if interleave > 1 else core
+
     if zero_stage >= 2:
         # both schedules feed the explicit ZeRO-2 core through ONE contract:
         # (params, batch, rng) -> (pipe-psum'd loss, pipe-correct full local
@@ -399,7 +591,7 @@ def make_pp_train_step(
         # the pipe-replicated params' partial grads.
         def gpipe_loss_and_grads(params, batch, rng):
             local_loss, grads = jax.value_and_grad(
-                lambda p: core(p, batch, rng, reduce=False)
+                lambda p: wavefront(p, batch, rng, reduce=False)
             )(params)
             grads = _psum_pipe_replicated(grads, _pipe_sharded_map(plan))
             return jax.lax.psum(local_loss, PIPE_AXIS), grads
@@ -409,7 +601,7 @@ def make_pp_train_step(
 
     param_specs = jax.tree.map(lambda ns: _pipe_part(ns.spec), plan.state.params)
     pp_loss = shard_map(
-        core,
+        wavefront,
         mesh=mesh,
         in_specs=(param_specs, P(), P()),
         out_specs=P(),

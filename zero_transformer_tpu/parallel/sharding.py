@@ -60,19 +60,42 @@ def unbox(boxed_params) -> Any:
     return nn.meta.unbox(boxed_params)
 
 
-def _tp_axes(logical: P, mesh: Mesh) -> tuple:
-    """Map one param's logical spec to mesh axes via LOGICAL_RULES."""
+def _tp_axes(logical: P, mesh: Mesh, rules: Optional[dict] = None) -> tuple:
+    """Map one param's logical spec to mesh axes via LOGICAL_RULES (or an
+    override table — the interleaved pipeline schedule drops the
+    layers→pipe rule, see ``plan_rules``)."""
+    rules = LOGICAL_RULES if rules is None else rules
     out = []
     for name in logical:
         if name is None:
             out.append(None)
             continue
-        axis = LOGICAL_RULES.get(name)
+        axis = rules.get(name)
         if axis is not None and mesh.shape.get(axis, 1) > 1:
             out.append(axis)
         else:
             out.append(None)
     return tuple(out)
+
+
+def plan_rules(pp_schedule: str = "gpipe") -> dict:
+    """Logical-rule table for a pipeline schedule.
+
+    gpipe/1f1b shard the stacked layer dim over ``pipe`` (each rank owns a
+    CONTIGUOUS block of layers — its stage). The interleaved schedule runs
+    virtual stage v of rank r on layers ``[(v*P + r)*Lc, ...)`` — a
+    round-robin assignment a contiguous PartitionSpec shard cannot express
+    (Megatron stores those layers rank-locally by construction). Rather
+    than permute weights across ranks every step, interleaved stores the
+    block stack pipe-REPLICATED and each rank slices its virtual chunks
+    locally: layer grads come back as disjoint per-rank partials summed by
+    the pipe psum the engine already runs for wte/ln_f/head. The trade —
+    pipe-degree × block-param memory, same as plain DP — is reported by
+    ``trainer.memory_analysis`` per schedule.
+    """
+    if pp_schedule == "interleaved":
+        return {**LOGICAL_RULES, "layers": None}
+    return LOGICAL_RULES
 
 
 def _add_zero_axis(shape: tuple, tp: tuple, mesh: Mesh, axes: tuple[str, ...]) -> tuple:
@@ -100,6 +123,7 @@ def param_sharding(
     abstract_params: Any,
     logical: Any,
     zero_stage: int = 1,
+    rules: Optional[dict] = None,
 ) -> Any:
     """NamedSharding pytree for the *stored* master params.
 
@@ -109,7 +133,7 @@ def param_sharding(
     zaxes = zero_axes(mesh)
 
     def one(leaf, spec):
-        tp = _tp_axes(spec, mesh)
+        tp = _tp_axes(spec, mesh, rules)
         if zero_stage >= 3:
             tp = _add_zero_axis(leaf.shape, tp, mesh, zaxes)
         return NamedSharding(mesh, P(*tp))
@@ -117,14 +141,16 @@ def param_sharding(
     return jax.tree.map(one, abstract_params, logical)
 
 
-def zero_sharding(mesh: Mesh, abstract_params: Any, logical: Any) -> Any:
+def zero_sharding(
+    mesh: Mesh, abstract_params: Any, logical: Any, rules: Optional[dict] = None
+) -> Any:
     """Fully ZeRO-sharded specs (TP + ZeRO axis) — the layout for optimizer
     state (stage≥1), gradient reduce-scatter targets (stage≥2), and stage-3
     params. Counterpart of reference ``set_partitions_zero`` (``partition.py:90-111``)."""
     zaxes = zero_axes(mesh)
 
     def one(leaf, spec):
-        tp = _tp_axes(spec, mesh)
+        tp = _tp_axes(spec, mesh, rules)
         tp = _add_zero_axis(leaf.shape, tp, mesh, zaxes)
         return NamedSharding(mesh, P(*tp))
 
@@ -165,11 +191,17 @@ def opt_state_sharding(
     )
 
 
-def topology_summary(mesh: Mesh, zero_stage: int) -> dict:
+def topology_summary(
+    mesh: Mesh, zero_stage: int, pp_schedule: str = "gpipe"
+) -> dict:
     """JSON-serializable description of the topology a checkpoint was saved
     under — written into every step's ``meta`` so elastic resume can compare
     the saved world against the one it is restoring onto (and refuse, or
-    log the reshard, BEFORE any array IO or compilation)."""
+    log the reshard, BEFORE any array IO or compilation). ``pp_schedule``
+    matters because it changes the STORED layout of the block stack
+    (interleaved stores it pipe-replicated) — a schedule change is elastic
+    (orbax reshards natively, same logical tree) but must be visible in the
+    resume log."""
     import jax
 
     return {
@@ -177,6 +209,7 @@ def topology_summary(mesh: Mesh, zero_stage: int) -> dict:
         "devices": int(mesh.devices.size),
         "processes": int(jax.process_count()),
         "zero_stage": int(zero_stage),
+        "pp_schedule": str(pp_schedule),
     }
 
 
@@ -185,6 +218,7 @@ def check_elastic_compat(
     mesh: Mesh,
     zero_stage: int,
     global_batch: int,
+    pp_schedule: str = "gpipe",
 ) -> list[str]:
     """Validate resuming onto ``mesh`` from a checkpoint saved under
     ``saved`` (a ``topology_summary``; None for pre-manifest checkpoints).
@@ -211,7 +245,7 @@ def check_elastic_compat(
     notes: list[str] = []
     if not saved:
         return notes
-    new = topology_summary(mesh, zero_stage)
+    new = topology_summary(mesh, zero_stage, pp_schedule)
     if saved.get("devices") != new["devices"]:
         notes.append(
             f"device count {saved.get('devices')} -> {new['devices']} "
@@ -228,6 +262,23 @@ def check_elastic_compat(
     if saved.get("processes") != new["processes"]:
         notes.append(
             f"process count {saved.get('processes')} -> {new['processes']}"
+        )
+    # pre-PR-8 checkpoints have no pp_schedule key; they were all saved
+    # under the gpipe/1f1b CONTIGUOUS layer sharding, for which the stored
+    # layout is identical — compare against that default
+    old_sched = saved.get("pp_schedule", "gpipe")
+    if old_sched != new["pp_schedule"]:
+        relayout = "interleaved" in (old_sched, new["pp_schedule"])
+        notes.append(
+            f"pp_schedule {old_sched} -> {new['pp_schedule']}"
+            + (
+                " (same logical state tree; the block stack restores from "
+                "pipe-sharded to pipe-replicated storage or back — orbax "
+                "reshards natively, and the loader position is in global "
+                "batches, so the token trajectory continues exactly)"
+                if relayout
+                else " (same stored layout — schedule change only)"
+            )
         )
     return notes
 

@@ -67,17 +67,26 @@ def make_plan(
     mesh: Mesh,
     sample_input_shape: tuple,
     zero_stage: int = 1,
+    pp_schedule: str = "gpipe",
 ) -> ShardingPlan:
-    """Derive every sharding from abstract shapes — no real allocation."""
+    """Derive every sharding from abstract shapes — no real allocation.
+
+    ``pp_schedule`` selects the layer-stack storage rule: gpipe/1f1b shard
+    the stacked layer dim contiguously over ``pipe``; interleaved stores it
+    pipe-replicated (see ``sharding.plan_rules``). Meshes without a pipe
+    axis are unaffected by either."""
 
     def _init(rng):
         return model.init(rng, jnp.zeros(sample_input_shape, jnp.int32))
 
+    rules = shd.plan_rules(pp_schedule)
     boxed = jax.eval_shape(_init, jax.random.PRNGKey(0))["params"]
     logical = shd.logical_specs(boxed)
     abstract_params = shd.unbox(boxed)
-    param_specs = shd.param_sharding(mesh, abstract_params, logical, zero_stage)
-    zero_specs = shd.zero_sharding(mesh, abstract_params, logical)
+    param_specs = shd.param_sharding(
+        mesh, abstract_params, logical, zero_stage, rules=rules
+    )
+    zero_specs = shd.zero_sharding(mesh, abstract_params, logical, rules=rules)
     abstract_opt = jax.eval_shape(tx.init, abstract_params)
     opt_specs = shd.opt_state_sharding(
         mesh, abstract_opt, abstract_params, zero_specs if zero_stage >= 1 else param_specs
@@ -167,6 +176,8 @@ def make_train_step(
     tx_factory: Optional[Callable] = None,
     pp_schedule: str = "gpipe",
     grad_accum_dtype: str = "float32",
+    pp_interleave: int = 1,
+    overlap_comm: bool = False,
 ) -> Callable:
     """Build the fused jitted train step.
 
@@ -201,11 +212,17 @@ def make_train_step(
     if mesh.shape[PIPE_AXIS] > 1:
         from zero_transformer_tpu.parallel.pipeline import make_pp_train_step
 
+        if overlap_comm:
+            raise ValueError(
+                "overlap_comm does not apply to pipe meshes: the pipeline "
+                "engine owns its own collective schedule (pp_schedule)"
+            )
         # 1F1B accepts bfloat16 (its accumulator is a hand-placed scan
         # carry); GPipe rejects it there (accumulation lives in scan-VJP)
         return make_pp_train_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory,
             pp_schedule=pp_schedule, grad_accum_dtype=grad_accum_dtype,
+            pp_interleave=pp_interleave,
         )
     # sequence x tensor x explicit-core: XLA's SPMD partitioner CHECK-fails
     # (spmd_partitioner_util.cc:495 — the same upstream crash class as
@@ -217,6 +234,24 @@ def make_train_step(
         mesh.shape[SEQUENCE_AXIS] > 1 and mesh.shape[TENSOR_AXIS] > 1
         and os.environ.get("ZTPU_SEQ_TENSOR_EXPLICIT_PROBE") != "1"
     )
+    if overlap_comm:
+        from zero_transformer_tpu.parallel.overlap import make_overlap_zero_step
+
+        if zero_stage < 1:
+            raise ValueError(
+                "overlap_comm requires zero_stage >= 1 (stage 0 has no ZeRO "
+                "collective schedule to overlap)"
+            )
+        if seq_tensor:
+            raise NotImplementedError(
+                "overlap_comm on sequence x tensor meshes: those meshes "
+                "cannot run an explicit shard_map core on this XLA (see the "
+                "seq_tensor probe above) — drop overlap_comm or one axis"
+            )
+        return make_overlap_zero_step(
+            model, tx, mesh, plan, zero_stage, schedule, tx_factory,
+            grad_accum_dtype=grad_accum_dtype,
+        )
     if zero_stage >= 2 and not seq_tensor:
         return _make_explicit_zero_step(
             model, tx, mesh, plan, zero_stage, schedule, tx_factory,

@@ -40,6 +40,43 @@ from zero_transformer_tpu.utils.jax_compat import ensure_donatable
 log = logging.getLogger("zero_transformer_tpu")
 
 
+def _exposed_comm_from_artifact(
+    path: str, overlap_comm: bool
+) -> Optional[float]:
+    """Read the measured exposed-comm fraction for the ACTIVE overlap arm
+    from a BENCH_step.json (scripts/train_step_bench.py). Returns None —
+    the gauge stays unregistered — on a missing/unreadable artifact or one
+    from a different backend than this process (a CPU-box measurement must
+    not masquerade as this TPU's decomposition)."""
+    import json
+
+    import jax as _jax
+
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, ValueError):
+        log.warning("step_bench_artifact %s unreadable; exposed_comm_frac "
+                    "gauge disabled", path)
+        return None
+    # (platform, device_kind) is the comparability key — the same rule the
+    # bench guard applies; a v4 measurement must not export as a v5e run's
+    # decomposition any more than a CPU one may
+    hw = (_jax.default_backend(), _jax.devices()[0].device_kind)
+    art_hw = (art.get("platform"), art.get("device_kind"))
+    if art_hw != hw:
+        log.warning(
+            "step_bench_artifact %s measured on %r but this run is on %r; "
+            "exposed_comm_frac gauge disabled (re-run "
+            "scripts/train_step_bench.py here)",
+            path, art_hw, hw,
+        )
+        return None
+    arm = art.get("overlap_on" if overlap_comm else "overlap_off") or {}
+    frac = arm.get("exposed_comm_frac")
+    return float(frac) if frac is not None else None
+
+
 def remap_loader_state(
     meta: Optional[dict],
     batch_size: int,
@@ -122,7 +159,10 @@ def build_training(cfg: Config, mesh=None) -> TrainingBuild:
     tx = make_optimizer(opt, schedule)
 
     sample_shape = (cfg.training.batch_size, cfg.training.train_context)
-    plan = make_plan(model, tx, mesh, sample_shape, cfg.mesh.zero_stage)
+    plan = make_plan(
+        model, tx, mesh, sample_shape, cfg.mesh.zero_stage,
+        pp_schedule=cfg.mesh.pp_schedule,
+    )
     train_step = make_train_step(
         model,
         tx,
@@ -137,12 +177,68 @@ def build_training(cfg: Config, mesh=None) -> TrainingBuild:
         ),
         pp_schedule=cfg.mesh.pp_schedule,
         grad_accum_dtype=cfg.training.grad_accum_dtype,
+        pp_interleave=cfg.mesh.pp_interleave,
+        overlap_comm=cfg.mesh.overlap_comm,
     )
     eval_step = make_eval_step(model, mesh, plan)
     return TrainingBuild(
         mesh=mesh, model=model, schedule=schedule, tx=tx, plan=plan,
         train_step=train_step, eval_step=eval_step, sample_shape=sample_shape,
     )
+
+
+def _schedule_memory(
+    cfg: Config, b: "TrainingBuild", abstract, accum: int
+) -> Dict[str, Any]:
+    """Analytic, schedule-aware memory itemization for ``memory_analysis``.
+
+    Estimates (clearly labeled — the compiled ``temp_bytes`` is the ground
+    truth when the backend reports it): per-microbatch activation bytes are
+    one residual-stream tensor [batch, T, d_model] at compute dtype; the
+    pipeline stash formulas count what each engine's wavefront keeps live
+    (GPipe/interleaved: the differentiated tick scan saves its carry once
+    per tick; 1F1B: the hand-managed 2P-slot input ring)."""
+    from zero_transformer_tpu.config import resolve_dtype
+    from zero_transformer_tpu.parallel.pipeline import bubble_fraction
+
+    mc = cfg.mesh
+    P_ = mc.pipe
+    V = mc.pp_interleave
+    out: Dict[str, Any] = {
+        "pp_schedule": mc.pp_schedule,
+        "pp_interleave": V,
+        "overlap_comm": mc.overlap_comm,
+        "bubble_frac": round(bubble_fraction(mc.pp_schedule, P_, accum, V), 5),
+    }
+    act = (
+        cfg.training.batch_size
+        * cfg.training.train_context
+        * cfg.model.d_model
+        * jnp.dtype(resolve_dtype(cfg.model.compute_dtype)).itemsize
+    )
+    out["microbatch_activation_bytes"] = act
+    if P_ > 1:
+        stash_ticks = {
+            "gpipe": accum + P_ - 1,
+            "1f1b": 2 * P_,
+            "interleaved": V * accum + P_ - 1,
+        }[mc.pp_schedule]
+        out["pp_activation_stash_bytes_est"] = stash_ticks * act
+        if mc.pp_schedule == "interleaved":
+            # interleaved stores the block stack pipe-replicated (see
+            # sharding.plan_rules): P-1 extra copies vs the contiguous shard
+            blocks_bytes = sum(
+                leaf.size * jnp.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree.leaves(abstract.params["blocks"])
+            )
+            out["pp_block_replication_extra_bytes"] = (P_ - 1) * (
+                blocks_bytes // P_
+            )
+    if mc.overlap_comm:
+        from zero_transformer_tpu.parallel.overlap import bucket_summary
+
+        out["overlap_buckets"] = bucket_summary(b.plan, b.mesh, abstract.params)
+    return out
 
 
 def memory_analysis(cfg: Config, accum: Optional[int] = None) -> Dict[str, Any]:
@@ -155,7 +251,15 @@ def memory_analysis(cfg: Config, accum: Optional[int] = None) -> Dict[str, Any]:
     Compiled sizes (argument/output/temp/alias/peak) are PER DEVICE —
     exactly what must fit one chip's HBM; the ``*_global`` keys are the
     logical whole-tree sizes. Backends without ``memory_analysis`` support
-    fall back to the shape-derived global totals with ``"exact": False``."""
+    fall back to the shape-derived global totals with ``"exact": False``.
+
+    The ``schedule`` block keeps the estimate honest per training schedule:
+    the pipeline engines stash activations across the wavefront (O(M) ticks
+    for GPipe, the 2P-slot ring for 1F1B, O(V*M) ticks for interleaved —
+    which ALSO stores the block stack pipe-replicated), and ``overlap_comm``
+    keeps up to two gathered layer buckets live while the scan runs; all of
+    that is inside the compiled ``temp_bytes`` when exact, and itemized
+    analytically here so a CPU sizing pass still sees it."""
     b = build_training(cfg)
     abstract = ckpt_lib.abstract_state(b.model, b.tx, b.plan, b.sample_shape)
     accum = accum or cfg.training.gradient_accumulation_steps
@@ -177,6 +281,7 @@ def memory_analysis(cfg: Config, accum: Optional[int] = None) -> Dict[str, Any]:
         "batch_bytes_global": _tree_bytes(batch),
         "n_devices": len(b.mesh.devices.ravel()),
         "tokens_per_step": accum * b.sample_shape[0] * b.sample_shape[1],
+        "schedule": _schedule_memory(cfg, b, abstract, max(accum, 1)),
     }
     try:
         ma = compiled.memory_analysis()
@@ -284,6 +389,40 @@ class Trainer:
         )
         self.tracer = Tracer(capacity=16384)
         self.flight = FlightRecorder(directory=obs_dir, tracer=self.tracer)
+        # step-time decomposition gauges (PR 8): bubble_frac is ANALYTIC —
+        # exact for the configured schedule (pipeline.bubble_fraction, the
+        # same formula the bench and memory_analysis use); exposed_comm_frac
+        # is a MEASUREMENT and only reported when the operator points
+        # training.step_bench_artifact at a BENCH_step.json measured for
+        # this platform (scripts/train_step_bench.py). Scrape them from
+        # /metrics via train.py --metrics-port (obs.MetricsExporter).
+        from zero_transformer_tpu.obs import Registry
+        from zero_transformer_tpu.parallel.pipeline import bubble_fraction
+
+        self.registry = Registry()
+        self._bubble_frac = bubble_fraction(
+            cfg.mesh.pp_schedule,
+            cfg.mesh.pipe,
+            max(cfg.training.gradient_accumulation_steps, 1),
+            cfg.mesh.pp_interleave,
+        )
+        self._exposed_comm_frac: Optional[float] = None
+        if cfg.training.step_bench_artifact:
+            self._exposed_comm_frac = _exposed_comm_from_artifact(
+                cfg.training.step_bench_artifact, cfg.mesh.overlap_comm
+            )
+        self.registry.gauge_func(
+            "train_bubble_frac",
+            "analytic pipeline-bubble fraction of the configured schedule",
+            lambda: self._bubble_frac,
+        )
+        if self._exposed_comm_frac is not None:
+            self.registry.gauge_func(
+                "train_exposed_comm_frac",
+                "measured exposed-communication fraction of step time "
+                "(from training.step_bench_artifact)",
+                lambda: self._exposed_comm_frac,
+            )
         self.rng = jax.random.PRNGKey(cfg.training.seed)
         # validation window pin: source state captured at first evaluate(),
         # restored before every later one, so eval always scores the SAME
@@ -318,7 +457,9 @@ class Trainer:
 
         return {
             "loader": self.train_loader.state(),
-            "topology": shd.topology_summary(self.mesh, self.zero_stage),
+            "topology": shd.topology_summary(
+                self.mesh, self.zero_stage, self.cfg.mesh.pp_schedule
+            ),
             "schedule": {
                 "batch_size": self.cfg.training.batch_size,
                 "train_context": self.cfg.training.train_context,
@@ -339,6 +480,7 @@ class Trainer:
             self.mesh,
             self.zero_stage,
             self.cfg.training.batch_size,
+            pp_schedule=self.cfg.mesh.pp_schedule,
         )
         for note in notes:
             log.warning("elastic resume: %s", note)
@@ -735,6 +877,33 @@ class Trainer:
                         util = monitoring.mfu(tok_s / n_chips, self.flops_per_token)
                         if util is not None:
                             payload["mfu"] = util
+                        # step-time decomposition (PR 8): analytic bubble +
+                        # bench-measured exposed comm, as metric keys and as
+                        # estimate spans subdividing this logging window —
+                        # the same fractions the train_bubble_frac /
+                        # train_exposed_comm_frac gauges export on /metrics
+                        if self._bubble_frac > 0:
+                            payload["bubble_frac"] = self._bubble_frac
+                        if self._exposed_comm_frac is not None:
+                            payload["exposed_comm_frac"] = (
+                                self._exposed_comm_frac
+                            )
+                        if tr.enabled:
+                            comm = self._exposed_comm_frac or 0.0
+                            bub = self._bubble_frac
+                            t_phase = tr.clock() - dt
+                            for name, frac in (
+                                ("grads_compute", max(0.0, 1.0 - comm - bub)),
+                                ("comm_exposed", comm),
+                                ("bubble_wait", bub),
+                            ):
+                                if frac > 0:
+                                    tr.add(
+                                        name, "train", t_phase,
+                                        t_phase + dt * frac,
+                                        {"step": step, "estimate": True},
+                                    )
+                                    t_phase += dt * frac
                     hbm = monitoring.hbm_device_stats()
                     if hbm is not None:
                         # max across local devices (the OOM-relevant number;
