@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos obs bench bench-watch serve-bench train-bench e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos obs bench bench-watch serve-bench train-bench e2e-watch fmt fmt-check dryrun
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on
@@ -43,6 +43,17 @@ elastic-chaos:
 serve-chaos:
 	$(PY) -m pytest tests/test_serving_resilience.py -q -m chaos $(PYTEST_ARGS)
 
+# Fleet-router fault-injection lane (ISSUE 9): 3 real subprocess replicas
+# under live streaming load through the router — one SIGKILLed mid-stream
+# (every in-flight stream must resume token-exact on a survivor or end with
+# a retryable terminal event; the victim is ejected with a flight-recorder
+# dump) — plus a rolling fleet reload under load with dropped_streams == 0.
+# The fast deterministic router cases (registry state machine, routing
+# policy, stub-fleet failover/reload over HTTP) are un-marked and run in
+# the quick lane.
+router-chaos:
+	$(PY) -m pytest tests/test_router.py -q -m chaos $(PYTEST_ARGS)
+
 # Observability lane (ISSUE 7): the obs test file (span-tree parity over
 # every request outcome, Prometheus exposition conformance under live
 # traffic, X-Request-Id round trip, flight-recorder dump on breaker-open,
@@ -70,20 +81,27 @@ bench:
 #  - shared-prefix run (N personas x one system prompt; with paging a hit
 #    is a page-refcount bump) -> BENCH_serve_prefix.json;
 #  - capacity sweep: slab vs paged concurrent streams at EQUAL KV budget
-#    -> BENCH_serve_capacity.json (the >=4x concurrency evidence).
+#    -> BENCH_serve_capacity.json (the >=4x concurrency evidence);
+#  - fleet-router scaling: paced stub replicas behind the real router,
+#    aggregate relayed tok/s at 1/2/4 replicas + token-exact mid-stream
+#    failover + rolling reload with zero drops -> BENCH_router.json (the
+#    guard holds the >= 3x near-linear bar on matching hardware and the
+#    correctness fields everywhere).
 # A regression guard compares the fresh runs against the previously
-# committed artifacts (>15% on decode_tok_s / itl p99 / capacity ratio
-# fails loudly on matching hardware, skips otherwise). Schema pinned by
-# tests/test_serve_bench.py.
+# committed artifacts (>15% on decode_tok_s / itl p99 / capacity ratio /
+# router scaling fails loudly on matching hardware, skips otherwise).
+# Schema pinned by tests/test_serve_bench.py.
 serve-bench:
 	@cp BENCH_serve.json /tmp/_serve_baseline.json 2>/dev/null || true
 	@cp BENCH_serve_capacity.json /tmp/_serve_cap_baseline.json 2>/dev/null || true
+	@cp BENCH_router.json /tmp/_serve_router_baseline.json 2>/dev/null || true
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
 		--spec-k 4 --greedy --max-new-tokens 32 --cache-len 64 --obs-ab
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
 		--shared-prefix --cache-len 64 --out BENCH_serve_prefix.json
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --capacity-sweep \
 		--cache-len 128 --max-new-tokens 8
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --router
 	@if [ -f /tmp/_serve_baseline.json ]; then \
 		$(PY) scripts/serve_bench_guard.py /tmp/_serve_baseline.json BENCH_serve.json; \
 	else \
@@ -93,6 +111,11 @@ serve-bench:
 		$(PY) scripts/serve_bench_guard.py /tmp/_serve_cap_baseline.json BENCH_serve_capacity.json; \
 	else \
 		echo "serve-bench-guard: no committed capacity baseline; skipping"; \
+	fi
+	@if [ -f /tmp/_serve_router_baseline.json ]; then \
+		$(PY) scripts/serve_bench_guard.py /tmp/_serve_router_baseline.json BENCH_router.json; \
+	else \
+		echo "serve-bench-guard: no committed router baseline; skipping"; \
 	fi
 
 # Training step-time decomposition lane (ISSUE 8): overlap-on/off A/B with

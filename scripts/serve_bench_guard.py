@@ -34,6 +34,9 @@ TOLERANCE = 0.15
 # A/B inside one artifact ran both arms on the same box minutes apart, so
 # unlike the baseline comparison there is no hardware-mismatch skip
 OBS_OVERHEAD_MAX = 0.02
+# the fleet router's near-linear-scaling bar (ISSUE 9): aggregate relayed
+# tok/s at the largest fleet must be >= this multiple of the 1-replica run
+ROUTER_SCALING_MIN = 3.0
 
 
 def compare_capacity(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
@@ -51,9 +54,77 @@ def compare_capacity(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
     return True, msgs
 
 
+def compare_router(
+    baseline: dict, fresh: dict, tolerance: float = TOLERANCE,
+    grade_scaling: bool = True,
+):
+    """BENCH_router.json pair. Correctness fields (zero dropped streams, a
+    token-exact resumed failover, a clean rolling reload) grade on ANY
+    hardware — a dropped stream is a dropped stream wherever it ran; they
+    were already hard-enforced by the loadgen at artifact-write time and
+    are re-checked so a hand-edited or stale artifact cannot sneak past.
+    The scaling ratio (the absolute near-linear bar + the baseline
+    tolerance) only grades on matching hardware, like every other perf
+    number in this guard."""
+    msgs = []
+    ok = True
+    if fresh.get("dropped_streams", -1) != 0:
+        ok = False
+        msgs.append(
+            f"FAIL: router artifact has dropped_streams="
+            f"{fresh.get('dropped_streams')} (must be 0)"
+        )
+    failover = fresh.get("failover") or {}
+    if not failover.get("token_exact"):
+        ok = False
+        msgs.append("FAIL: router failover segment was not token-exact")
+    reload_block = fresh.get("rolling_reload") or {}
+    if not reload_block.get("ok") or reload_block.get("dropped_streams"):
+        ok = False
+        msgs.append(f"FAIL: rolling reload {reload_block}")
+    if not grade_scaling:
+        msgs.append(
+            "SKIP: hardware mismatch vs baseline; router scaling ratio "
+            "not graded (correctness fields were)"
+        )
+        return ok, msgs
+    ratio = fresh.get("value", 0)
+    if ratio < ROUTER_SCALING_MIN:
+        ok = False
+        msgs.append(
+            f"REGRESSION: router scaling ratio {ratio:.2f} < the "
+            f"near-linear bar {ROUTER_SCALING_MIN:.1f}"
+        )
+    else:
+        msgs.append(
+            f"ok: router scaling ratio {ratio:.2f} "
+            f"(bar {ROUTER_SCALING_MIN:.1f})"
+        )
+    base_ratio = baseline.get("value", 0)
+    if base_ratio and ratio < base_ratio * (1 - tolerance):
+        ok = False
+        msgs.append(
+            f"REGRESSION: router scaling ratio {ratio:.2f} < "
+            f"{(1 - tolerance) * 100:.0f}% of baseline {base_ratio:.2f}"
+        )
+    return ok, msgs
+
+
 def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
     """Returns (ok, messages). ok=True covers both pass and skip."""
     msgs = []
+    # the router artifact dispatches before the generic platform gate: its
+    # correctness fields must grade everywhere, only its scaling perf is
+    # hardware-gated
+    if str(fresh.get("metric", "")) == "router_scaling_tok_s":
+        grade = (
+            baseline.get("metric") == fresh.get("metric")
+            and bool(baseline.get("platform"))
+            and baseline.get("platform") == fresh.get("platform")
+        )
+        return compare_router(
+            baseline if grade else {}, fresh, tolerance, grade_scaling=grade
+        )
     base_platform = baseline.get("platform")
     fresh_platform = fresh.get("platform")
     if not base_platform or not fresh_platform:

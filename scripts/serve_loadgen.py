@@ -102,6 +102,44 @@ def parse_args(argv=None):
                    help="decode slots for the PAGED engine in the sweep "
                         "(its concurrency ceiling; the slab engine's slot "
                         "count is fixed by the memory budget)")
+    p.add_argument("--router", action="store_true",
+                   help="fleet-router mode: spawn N in-process PACED stub "
+                        "replicas (fixed inter-token interval — models "
+                        "device-bound decode whose rate does not depend on "
+                        "this box's CPU) behind a real RouterServer and "
+                        "measure what the ROUTER contributes: aggregate "
+                        "relayed tok/s scaling replicas 1 -> N, prefix-"
+                        "affinity hit rate, mid-stream failover, and a "
+                        "rolling fleet reload with dropped_streams == 0. "
+                        "Emits BENCH_router.json instead of the standard "
+                        "artifact")
+    p.add_argument("--router-replicas", type=int, default=4,
+                   help="largest fleet size in the scaling sweep (the sweep "
+                        "runs 1, 2, ... doubling up to this)")
+    p.add_argument("--router-clients", type=int, default=0,
+                   help="closed-loop client count (0 = replica slots x the "
+                        "largest fleet, so the biggest fleet is exactly "
+                        "saturated and smaller ones queue)")
+    p.add_argument("--router-requests", type=int, default=3,
+                   help="requests per client per sweep point (each client "
+                        "reuses its own chunk-aligned prefix, so request "
+                        "2..N of a client should ride prefix affinity)")
+    p.add_argument("--router-max-new", type=int, default=48,
+                   help="tokens generated per router-mode request")
+    p.add_argument("--router-itl-ms", type=float, default=10.0,
+                   help="stub replica inter-token interval (the paced "
+                        "'device' speed the router must keep up with; "
+                        "long enough that per-request admission overhead "
+                        "amortizes and scheduler-oversleep noise on a "
+                        "shared box stays small vs the pace)")
+    p.add_argument("--router-repeats", type=int, default=3,
+                   help="repeats per sweep point, best-of (CPU-neighbor "
+                        "noise only ever slows a run down — the best run "
+                        "is the router's real cost, the BENCHMARKS.md "
+                        "best-of-N discipline); correctness must hold in "
+                        "EVERY repeat")
+    p.add_argument("--router-slots", type=int, default=2,
+                   help="concurrent decode slots per stub replica")
     p.add_argument("--max-queue", type=int, default=1024,
                    help="admission-queue depth (large: the loadgen measures "
                         "latency under queueing, not reject behavior)")
@@ -409,6 +447,307 @@ def run_capacity_sweep(args, cfg, cache_len, make_engine) -> dict:
     return artifact
 
 
+# ------------------------------------------------------- fleet router bench
+
+
+def _platform_block() -> dict:
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+    }
+
+
+def _sse_collect(port: int, body: dict, timeout: float = 120.0):
+    """Minimal SSE client against the router: returns (token_ids, done_event)
+    for streams, or (tokens, doc) for JSON rejections."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/generate", json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if "text/event-stream" not in resp.getheader("Content-Type", ""):
+            return [], json.loads(resp.read() or b"{}")
+        ids, done = [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data: "):
+                continue
+            event = json.loads(line[6:])
+            if event.get("done"):
+                done = event
+                break
+            if "token" in event:
+                ids.append(int(event["token"]))
+        return ids, done
+    finally:
+        conn.close()
+
+
+def _drive_router_fleet(router, prompts, n_requests, max_new, expect_base):
+    """Closed loop: one thread per prompt family, ``n_requests`` streams
+    each (same family prefix, varying tail). Returns (wall_s, tokens_ok,
+    streams_done, mismatches, hung)."""
+    results: list = []
+    lock = threading.Lock()
+
+    def client(prefix):
+        for j in range(n_requests):
+            prompt = prefix + [101 + j]
+            ids, done = _sse_collect(
+                router.port, {"tokens": prompt, "max_new_tokens": max_new}
+            )
+            first = expect_base + len(prompt)
+            ok = (
+                done is not None
+                and done.get("status") == "done"
+                and ids == list(range(first, first + max_new))
+            )
+            with lock:
+                results.append((len(ids), done, ok))
+
+    threads = [
+        threading.Thread(target=client, args=(p,), daemon=True)
+        for p in prompts
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.monotonic() - t0
+    hung = sum(1 for t in threads if t.is_alive())
+    expected = len(prompts) * n_requests
+    done_n = sum(1 for _, done, _ in results if done and done.get("done"))
+    mismatches = sum(1 for _, _, ok in results if not ok)
+    tokens = sum(n for n, _, _ in results)
+    return wall, tokens, done_n, mismatches + (expected - len(results)), hung
+
+
+def run_router_bench(args) -> dict:
+    """The fleet-scaling measurement (ISSUE 9). Replicas are PACED stubs
+    (``scripts/serve_router.py`` StubReplica): each emits deterministic
+    token ids at a fixed inter-token interval with a bounded slot count —
+    a model of a device-bound replica whose decode rate does not depend on
+    this box's CPU. What IS measured on this box is the part that runs on a
+    router box in production: the relay loop, the routing policy, failover,
+    and the rolling reload. Three segments:
+
+    - **scaling sweep**: the same closed-loop client pool against fleets of
+      1, 2, ... --router-replicas; aggregate relayed tok/s should track the
+      fleet's aggregate pace near-linearly (the guard's >= 3x at 1 -> 4 bar)
+      with every stream token-exact vs the stubs' arithmetic sequence;
+    - **failover**: one replica armed to die mid-stream; the client stream
+      must resume on the survivor and stay token-exact end to end;
+    - **rolling reload**: a 3-replica fleet reloaded one replica at a time
+      under live streams; ``dropped_streams`` must stay 0.
+    """
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_router", REPO / "scripts" / "serve_router.py"
+    )
+    serve_router = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_router)
+    from zero_transformer_tpu.serving.router import RouterServer
+
+    itl_s = args.router_itl_ms / 1e3
+    slots = args.router_slots
+    chunk = 4
+    counts = [1]
+    while counts[-1] * 2 <= args.router_replicas:
+        counts.append(counts[-1] * 2)
+    clients = args.router_clients or slots * counts[-1]
+    max_new = args.router_max_new
+    # one fixed chunk-aligned prefix per client: requests 2..N of a client
+    # should ride prefix affinity back to the replica that served request 1
+    prefixes = [[10 + i] * (2 * chunk) for i in range(clients)]
+    dropped_total = 0
+    failures: list = []
+
+    def fleet(n, **kw):
+        stubs = [
+            serve_router.StubReplica(itl_s=itl_s, slots=slots, **kw).start()
+            for _ in range(n)
+        ]
+        router = RouterServer(
+            [s.url for s in stubs], probe_interval=0.05, chunk_tokens=chunk,
+            max_attempts=4, stream_timeout=60.0,
+        )
+        router.start()
+        if not router.wait_ready(10.0):
+            raise SystemExit("ROUTER BENCH FAILED: fleet never became ready")
+        return stubs, router
+
+    def teardown(stubs, router):
+        nonlocal dropped_total
+        dropped_total += router.stats["dropped_streams"]
+        router.stop()
+        for s in stubs:
+            s.stop()
+
+    # ---- segment 1: scaling sweep (best-of --router-repeats per point:
+    # neighbor contention only slows a run down, so the best repeat is the
+    # router's real relay cost; correctness must hold in EVERY repeat)
+    scaling = []
+    routing = None
+    repeats = max(1, args.router_repeats)
+    for n in counts:
+        best = None
+        for rep_i in range(repeats):
+            stubs, router = fleet(n)
+            wall, tokens, done_n, mismatches, hung = _drive_router_fleet(
+                router, prefixes, args.router_requests, max_new,
+                expect_base=1000,
+            )
+            snap = router.metrics_snapshot()
+            expected = clients * args.router_requests
+            if hung or done_n != expected or mismatches:
+                failures.append(
+                    f"scaling@{n} repeat {rep_i}: {hung} hung, "
+                    f"{done_n}/{expected} done, "
+                    f"{mismatches} token-sequence mismatches"
+                )
+            per_replica = {
+                rid: round(info["tokens_relayed"] / wall, 1)
+                for rid, info in snap["replicas"].items()
+            }
+            point = {
+                "replicas": n,
+                "aggregate_tok_s": round(tokens / wall, 1),
+                "per_replica_tok_s": sorted(
+                    per_replica.values(), reverse=True
+                ),
+                "wall_s": round(wall, 3),
+                "streams": done_n,
+                "repeats": repeats,
+                "affinity_hit_rate": round(snap["affinity_hit_rate"], 4),
+                "failovers": snap["failovers"],
+            }
+            teardown(stubs, router)
+            if best is None or point["aggregate_tok_s"] > best[0]["aggregate_tok_s"]:
+                best = (point, snap)
+        scaling.append(best[0])
+        if n == counts[-1]:
+            snap = best[1]
+            routing = {
+                "affinity_hits": snap["affinity_hits"],
+                "affinity_misses": snap["affinity_misses"],
+                "hit_rate": round(snap["affinity_hit_rate"], 4),
+            }
+
+    # ---- segment 2: mid-stream failover on a survivor, token-exact
+    victim = serve_router.StubReplica(
+        itl_s=itl_s, slots=slots, die_after_tokens=3
+    ).start()
+    survivor = serve_router.StubReplica(itl_s=itl_s, slots=slots).start()
+    router = RouterServer(
+        [victim.url, survivor.url], probe_interval=0.05, chunk_tokens=chunk,
+        max_attempts=4, stream_timeout=60.0,
+    )
+    router.start()
+    failover = {"failovers": 0, "resumed_streams": 0, "token_exact": False}
+    try:
+        if not router.wait_ready(10.0):
+            raise SystemExit("ROUTER BENCH FAILED: failover fleet not ready")
+        prompt = [3] * (2 * chunk)
+        router.affinity.record(prompt, f"127.0.0.1:{victim.port}")
+        ids, done = _sse_collect(
+            router.port, {"tokens": prompt, "max_new_tokens": 12}
+        )
+        first = 1000 + len(prompt)
+        failover = {
+            "failovers": router.stats["failovers"],
+            "resumed_streams": router.stats["resumed_streams"],
+            "token_exact": bool(
+                done is not None
+                and done.get("status") == "done"
+                and ids == list(range(first, first + 12))
+            ),
+        }
+        if not (victim.died and failover["token_exact"]
+                and failover["resumed_streams"] == 1):
+            failures.append(f"failover: {failover}, victim.died={victim.died}")
+    finally:
+        dropped_total += router.stats["dropped_streams"]
+        router.stop()
+        victim.stop()
+        survivor.stop()
+
+    # ---- segment 3: rolling reload under live streams, zero drops
+    stubs, router = fleet(3)
+    reload_result = {"ok": False, "steps": 0, "dropped_streams": -1}
+    try:
+        done_flags: list = []
+
+        def bg_client(i):
+            ids, done = _sse_collect(
+                router.port,
+                {"tokens": [70 + i] * chunk, "max_new_tokens": max_new},
+            )
+            done_flags.append(bool(done and done.get("status") == "done"))
+
+        bg = [
+            threading.Thread(target=bg_client, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in bg:
+            t.start()
+        time.sleep(4 * itl_s)  # streams mid-generation
+        ok, steps = router.rolling_reload(drain_timeout_s=60.0,
+                                          ready_timeout_s=60.0)
+        for t in bg:
+            t.join(timeout=120)
+        hung = sum(1 for t in bg if t.is_alive())
+        reload_result = {
+            "ok": bool(ok and not hung and all(done_flags)
+                       and len(done_flags) == 4),
+            "steps": sum(1 for s in steps if s.get("ok")),
+            "dropped_streams": router.stats["dropped_streams"],
+        }
+        if not reload_result["ok"] or reload_result["dropped_streams"]:
+            failures.append(f"rolling_reload: {reload_result}, steps={steps}")
+    finally:
+        teardown(stubs, router)
+
+    base = scaling[0]["aggregate_tok_s"]
+    peak = scaling[-1]["aggregate_tok_s"]
+    artifact = {
+        "metric": "router_scaling_tok_s",
+        "value": round(peak / base, 3) if base else 0.0,
+        "unit": f"aggregate tok/s ratio, {counts[-1]} replicas vs 1",
+        "replica_model": "paced_stub",
+        "replica_itl_ms": args.router_itl_ms,
+        "replica_slots": slots,
+        "clients": clients,
+        "requests_per_client": args.router_requests,
+        "max_new_tokens": max_new,
+        "scaling": scaling,
+        "aggregate_tok_s": peak,
+        "routing": routing,
+        "failover": failover,
+        "rolling_reload": reload_result,
+        "dropped_streams": dropped_total,
+        "platform": _platform_block(),
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    Path(args.out).write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    if failures or dropped_total:
+        raise SystemExit(
+            "ROUTER BENCH FAILED: "
+            + "; ".join(failures or [f"{dropped_total} dropped streams"])
+        )
+    return artifact
+
+
 def main(argv=None) -> dict:
     args = parse_args(argv)
     # some images pre-import jax with a platform baked into jax.config,
@@ -423,6 +762,10 @@ def main(argv=None) -> dict:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except RuntimeError:
             pass  # backend already initialized (e.g. under pytest)
+    if args.router:
+        if args.out == str(REPO / "BENCH_serve.json"):  # untouched default
+            args.out = str(REPO / "BENCH_router.json")
+        return run_router_bench(args)
     cfg, params, sampling, cache_len, make_engine = build(args)
     if args.capacity_sweep:
         if args.out == str(REPO / "BENCH_serve.json"):  # untouched default

@@ -46,6 +46,16 @@ CAPACITY_REQUIRED_KEYS = {
     "platform", "measured_at_utc",
 }
 
+ROUTER_REQUIRED_KEYS = {
+    # fleet-router evidence (ISSUE 9): the replica-scaling sweep, routing
+    # hit-rate, the token-exact mid-stream failover segment, and the
+    # rolling-reload zero-drop proof
+    "metric", "value", "unit", "replica_model", "replica_itl_ms",
+    "replica_slots", "clients", "requests_per_client", "max_new_tokens",
+    "scaling", "aggregate_tok_s", "routing", "failover", "rolling_reload",
+    "dropped_streams", "platform", "measured_at_utc",
+}
+
 
 def _load():
     spec = importlib.util.spec_from_file_location(
@@ -223,6 +233,103 @@ def test_loadgen_shared_prefix_hits_and_parity(tmp_path):
     # control's cold prefill (same workload, same seeds, same box)
     assert artifact["no_prefix_cache"] is not None
     assert artifact["prefill_ms_hit_p50"] < artifact["no_prefix_cache"]["prefill_ms_p50"]
+
+
+def test_loadgen_router_artifact(tmp_path):
+    """--router: the fleet-scaling scenario over paced stub replicas. Small
+    here (2-replica sweep, short streams) — tier-1 pins the artifact schema
+    and the correctness invariants (every stream token-exact, the failover
+    segment resumed exactly, rolling reload with zero drops); make
+    serve-bench runs the full 1 -> 4 sweep into the committed
+    BENCH_router.json where the guard holds the >= 3x near-linear bar."""
+    loadgen = _load()
+    out = tmp_path / "BENCH_router.json"
+    artifact = loadgen.main([
+        "--router", "--router-replicas", "2", "--router-requests", "2",
+        "--router-max-new", "12", "--router-itl-ms", "2",
+        "--router-repeats", "1", "--out", str(out),
+    ])
+    on_disk = json.loads(out.read_text())
+    assert on_disk == artifact
+    missing = ROUTER_REQUIRED_KEYS - set(artifact)
+    assert not missing, f"router artifact missing keys: {sorted(missing)}"
+    assert artifact["metric"] == "router_scaling_tok_s"
+    assert artifact["value"] > 1.0  # 2 replicas must beat 1
+    # sweep shape: 1 and 2 replicas, aggregate == sum of per-replica rates
+    assert [p["replicas"] for p in artifact["scaling"]] == [1, 2]
+    for point in artifact["scaling"]:
+        assert point["streams"] == artifact["clients"] * 2
+        assert len(point["per_replica_tok_s"]) == point["replicas"]
+        assert point["aggregate_tok_s"] > 0
+    # each client's 2nd request rides prefix affinity back to its replica
+    assert artifact["routing"]["hit_rate"] == 0.5
+    assert artifact["routing"]["affinity_hits"] > 0
+    # the failover segment resumed mid-stream, token-exact, on the survivor
+    assert artifact["failover"]["token_exact"] is True
+    assert artifact["failover"]["resumed_streams"] == 1
+    assert artifact["failover"]["failovers"] >= 1
+    # rolling reload under live streams: one step per replica, zero drops
+    assert artifact["rolling_reload"]["ok"] is True
+    assert artifact["rolling_reload"]["steps"] == 3
+    assert artifact["rolling_reload"]["dropped_streams"] == 0
+    assert artifact["dropped_streams"] == 0
+    assert set(artifact["platform"]) == {"backend", "device"}
+
+
+def test_serve_bench_guard_router_logic():
+    """Router-artifact guard branch: correctness fields fail on ANY
+    hardware, the scaling bar only grades against a matching-platform
+    baseline."""
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_guard", REPO / "scripts" / "serve_bench_guard.py"
+    )
+    guard = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(guard)
+
+    good = {
+        "metric": "router_scaling_tok_s", "value": 3.4,
+        "dropped_streams": 0,
+        "failover": {"token_exact": True, "resumed_streams": 1},
+        "rolling_reload": {"ok": True, "steps": 3, "dropped_streams": 0},
+        "platform": {"backend": "cpu", "device": "x"},
+    }
+    ok, _ = guard.compare(good, dict(good))
+    assert ok
+    # below the absolute near-linear bar fails on matching hardware
+    ok, msgs = guard.compare(good, {**good, "value": 2.4})
+    assert not ok and any("near-linear" in m for m in msgs)
+    # >15% below the committed baseline fails even above the bar
+    ok, msgs = guard.compare({**good, "value": 3.9}, {**good, "value": 3.2})
+    assert not ok and any("baseline" in m for m in msgs)
+    # hardware mismatch: scaling SKIPS instead of failing...
+    other_hw = {**good, "value": 2.4,
+                "platform": {"backend": "tpu", "device": "v4"}}
+    ok, msgs = guard.compare(good, other_hw)
+    assert ok and any("SKIP" in m for m in msgs)
+    # ...but dropped streams / a non-exact failover / a failed reload are
+    # correctness, and fail everywhere
+    ok, msgs = guard.compare(good, {**other_hw, "dropped_streams": 1})
+    assert not ok and any("dropped_streams" in m for m in msgs)
+    ok, msgs = guard.compare(
+        good, {**good, "failover": {"token_exact": False}}
+    )
+    assert not ok and any("token-exact" in m for m in msgs)
+    ok, msgs = guard.compare(
+        good,
+        {**good, "rolling_reload": {"ok": True, "steps": 3,
+                                    "dropped_streams": 2}},
+    )
+    assert not ok and any("rolling reload" in m for m in msgs)
+    # a throughput artifact as "baseline" (metric mismatch) has no
+    # comparable scaling number: the grade skips, correctness still checked
+    ok, msgs = guard.compare({"metric": "serve_tokens_per_sec_test",
+                              "platform": good["platform"]}, good)
+    assert ok
+    ok, msgs = guard.compare(
+        {"metric": "serve_tokens_per_sec_test"},
+        {**good, "dropped_streams": 3},
+    )
+    assert not ok
 
 
 def test_serve_bench_guard_logic():

@@ -6,8 +6,11 @@ The orchestration layer above the jitted decode path: a slot-based KV cache
 incremental detokenizer (``detok``), and the serving resilience layer
 (``resilience``: lifecycle state machine, decode-tick supervision with a
 circuit breaker, graceful drain, hot weight reload, deadline-aware load
-shedding, serving chaos harness). See docs/DESIGN.md § Serving engine and
-docs/RESILIENCE.md § Serving resilience.
+shedding, serving chaos harness), and the fleet tier above them all
+(``router``: replica registry with health probing and ejection,
+prefix-aware + least-loaded routing, mid-stream failover, rolling fleet
+reload). See docs/DESIGN.md § Serving engine, docs/SERVING.md § Fleet
+router, and docs/RESILIENCE.md § Serving resilience.
 """
 from zero_transformer_tpu.serving.detok import StreamDecoder
 from zero_transformer_tpu.serving.engine import (
@@ -38,6 +41,16 @@ from zero_transformer_tpu.serving.resilience import (
     ServeFault,
     ServingChaosMonkey,
 )
+from zero_transformer_tpu.serving.router import (
+    EJECTED,
+    PrefixAffinity,
+    Replica,
+    ReplicaRegistry,
+    RouterServer,
+    chunk_prefix_key,
+    pick_replica,
+    run_router,
+)
 from zero_transformer_tpu.serving.server import ServingServer, run_server
 from zero_transformer_tpu.serving.slots import (
     PagedKVCache,
@@ -49,11 +62,19 @@ from zero_transformer_tpu.serving.slots import (
 __all__ = [
     "DEGRADED",
     "DRAINING",
+    "EJECTED",
     "READY",
     "STARTING",
     "STOPPED",
     "CircuitBreaker",
     "Lifecycle",
+    "PrefixAffinity",
+    "Replica",
+    "ReplicaRegistry",
+    "RouterServer",
+    "chunk_prefix_key",
+    "pick_replica",
+    "run_router",
     "PagedKVCache",
     "PagedPrefixIndex",
     "PagePool",

@@ -1118,6 +1118,16 @@ class ServingEngine:
     def active_count(self) -> int:
         return sum(1 for a in self._active if a is not None)
 
+    @property
+    def free_pages(self) -> int:
+        """Spare KV capacity, in the unit the layout allocates: free pool
+        pages when paged, free decode slots when slab. A fleet router reads
+        this from /healthz as an admission input — "how much more can this
+        replica take" — without caring which layout backs it."""
+        if self.kv_layout == "paged":
+            return self.slots.pool.free_count
+        return max(0, self.n_slots - self.active_count - len(self._prefilling))
+
     # --------------------------------------------------------------- prefill
 
     def _bucket(self, length: int) -> int:

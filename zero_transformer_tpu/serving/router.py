@@ -1,0 +1,1490 @@
+"""Fault-tolerant fleet router: a control-plane tier over N engine replicas.
+
+Everything below this module hardens ONE ``ServingEngine`` replica (PR 3:
+lifecycle /healthz, supervised ticks, drain, hot reload). This router is the
+tier that makes a *fleet* of them survive what a single process cannot:
+replica death mid-stream, slow/sick replicas, and fleet-wide weight rollouts
+— the ROADMAP item-3 control plane. Stdlib-only HTTP (same discipline as
+``server.py``), so the fleet surface runs anywhere the replicas do.
+
+Pieces, each independently unit-testable without sockets:
+
+- **ReplicaRegistry**: active health probing of each replica's ``/healthz``,
+  honoring the PR 3 lifecycle states — READY routes, DEGRADED stays in
+  rotation but deprioritized, DRAINING/STOPPED leave rotation (they answer,
+  so they are *not* probe failures). Consecutive probe failures feed a
+  per-replica ``CircuitBreaker`` (the PR 3 primitive, reused); a trip EJECTS
+  the replica with exponential-backoff re-probing, and one successful probe
+  recovers it. The probe also scrapes the replica's admission inputs
+  (``itl_ewma_ms``, ``queue_depth``, ``active_slots``, ``free_pages`` —
+  served in the ``/healthz`` body exactly so the router needs one cheap
+  poll, not a ``/metrics`` scrape).
+- **Routing policy** (pure functions): prefix-aware first — the prompt's
+  chunk-aligned token prefix is mapped to the replica that served it last
+  (``PrefixAffinity``), so repeated/shared prefixes land where their K/V is
+  already cached and N per-replica prefix caches behave like one
+  distributed cache. Affinity only holds within the healthy pool: a READY
+  replica always beats a DEGRADED one, and ties break by least-loaded
+  admission (scraped queue depth + active slots + the router's own
+  in-flight relays, weighted by the replica's measured ITL EWMA).
+- **Failover**: requests relay with bounded retry + backoff across
+  replicas. Pre-stream failures (connect refused, 5xx/429) simply try the
+  next replica. The hard case is **mid-stream** death: the router counts
+  every token it has relayed, and when a replica dies under an active SSE
+  stream it re-dispatches the request to a survivor with ``prompt +
+  generated-so-far`` as the new prompt and the token budget reduced by what
+  was already delivered — the client sees a stall, then the stream resumes
+  (greedy sampling continues the exact trajectory; seeded stochastic
+  sampling continues *a* consistent trajectory). Non-resumable cases (text
+  prompt the router cannot re-tokenize, retry budget exhausted) terminate
+  with a retryable SSE error event — never a silent hang.
+- **Rolling fleet reload** (``POST /admin/reload`` on the router): one
+  replica at a time is cordoned (no new requests routed to it), the
+  router's in-flight relays to it drain to zero, the replica hot-reloads
+  via its own PR 3 ``/admin/reload`` path, the router waits for READY, then
+  uncordons and moves on — ``dropped_streams == 0`` by construction, chaos-
+  proven in ``tests/test_router.py`` / ``make router-chaos``.
+
+Observability: the router carries its own ``Tracer`` (every relayed request
+gets a span tree on its ``X-Request-Id`` track, each hop tagged with the
+``replica`` that served it — a Perfetto view shows exactly which replicas a
+failover crossed), a Prometheus ``Registry`` (``GET /metrics`` content-
+negotiates JSON vs text exposition like the replica server), and a
+``FlightRecorder`` that dumps the recent probe/relay window whenever a
+replica is ejected. ``X-Request-Id`` propagates verbatim: client → router →
+replica → back, so one id keys the request's spans on every tier.
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import math
+import re
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+from urllib.parse import urlsplit
+
+from zero_transformer_tpu.obs.flight import FlightRecorder
+from zero_transformer_tpu.obs.metrics import Registry
+from zero_transformer_tpu.obs.spans import Tracer
+from zero_transformer_tpu.serving.resilience import (
+    DEGRADED,
+    DRAINING,
+    READY,
+    CircuitBreaker,
+)
+
+# Replica states as the ROUTER sees them (a superset of the replica's own
+# lifecycle: the router must also represent "I cannot reach it at all").
+UNKNOWN = "unknown"  # never probed successfully yet
+EJECTED = "ejected"  # consecutive probe failures tripped the breaker
+
+# EXACTLY the engine's charset (engine.py _RID_UNSAFE): the id must survive
+# router -> replica re-sanitation verbatim or cross-tier span correlation
+# silently breaks for the characters the tiers disagree on
+_RID_UNSAFE = re.compile(r"[^A-Za-z0-9._:/=-]")
+
+
+def _clean_rid(request_id: Optional[str]) -> str:
+    """Same header-safe sanitation as the engine: the id is echoed into a
+    response header, so CR/LF injection and non-latin-1 must be impossible."""
+    if request_id:
+        clean = _RID_UNSAFE.sub("", str(request_id))[:128]
+        if clean:
+            return clean
+    return uuid.uuid4().hex
+
+
+# ------------------------------------------------------------------ registry
+
+
+@dataclasses.dataclass
+class Replica:
+    """One replica as the router tracks it: identity, probed lifecycle
+    state, scraped admission inputs, and router-side relay bookkeeping."""
+
+    id: str
+    url: str
+    host: str
+    port: int
+    state: str = UNKNOWN
+    cordoned: bool = False  # rolling reload: out of rotation, not ejected
+    consecutive_failures: int = 0
+    ejections: int = 0
+    backoff_s: float = 0.0
+    next_probe_at: float = 0.0
+    last_probe_at: Optional[float] = None
+    # admission inputs scraped from the replica's /healthz body (satellite:
+    # the body carries them so routing costs one poll, not a /metrics scrape)
+    itl_ewma_ms: float = 0.0
+    queue_depth: int = 0
+    active_slots: int = 0
+    free_pages: int = 0
+    breaker_open: bool = False
+    # router-side live view (fresher than the last probe)
+    active_relays: int = 0
+    tokens_relayed: int = 0
+    requests_routed: int = 0
+    breaker: CircuitBreaker = dataclasses.field(
+        default_factory=lambda: CircuitBreaker(threshold=3, cooldown=1)
+    )
+
+    @property
+    def routable(self) -> bool:
+        return self.state in (READY, DEGRADED) and not self.cordoned
+
+    def load_score(self) -> Tuple[float, int, str]:
+        """Estimated backlog drain time: requests ahead (scraped queue +
+        active slots + the router's own in-flight relays) weighted by the
+        replica's measured ITL EWMA. The EWMA floor keeps a cold replica
+        (no samples yet) attractive without dividing by zero; the id
+        tie-break keeps the policy deterministic."""
+        backlog = self.queue_depth + self.active_slots + self.active_relays
+        return (backlog * max(self.itl_ewma_ms, 0.1), backlog, self.id)
+
+
+def _parse_url(url: str) -> Tuple[str, str, int]:
+    parts = urlsplit(url if "//" in url else f"http://{url}")
+    host = parts.hostname or "127.0.0.1"
+    port = parts.port or 80
+    return f"{host}:{port}", host, port
+
+
+class ReplicaRegistry:
+    """Thread-safe replica table + the probe-outcome state machine.
+
+    Pure logic: no sockets. The server feeds it probe outcomes
+    (``observe_probe``) and relay failures (``observe_relay_failure``); it
+    answers "who is due a probe" (``due``, honoring the exponential backoff
+    of ejected replicas) and "who can take traffic" (``routable``).
+    """
+
+    def __init__(
+        self,
+        urls: Sequence[str],
+        clock=time.monotonic,
+        probe_interval: float = 0.25,
+        eject_threshold: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 8.0,
+    ):
+        if not urls:
+            raise ValueError("router needs at least one replica URL")
+        if eject_threshold < 1:
+            raise ValueError("eject_threshold must be >= 1")
+        self.clock = clock
+        self.probe_interval = probe_interval
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._lock = threading.Lock()
+        self.replicas: "OrderedDict[str, Replica]" = OrderedDict()
+        for url in urls:
+            rid, host, port = _parse_url(url)
+            if rid in self.replicas:
+                raise ValueError(f"duplicate replica {rid}")
+            self.replicas[rid] = Replica(
+                id=rid, url=url, host=host, port=port,
+                breaker=CircuitBreaker(threshold=eject_threshold, cooldown=1),
+            )
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    # ------------------------------------------------------------- observing
+
+    def observe_probe(
+        self,
+        rid: str,
+        ok: bool,
+        code: Optional[int] = None,
+        body: Optional[dict] = None,
+    ) -> List[Tuple[str, str]]:
+        """Fold one probe outcome into the replica's state. ``ok`` means the
+        probe got an HTTP response with a parseable body (whatever the
+        status code — a 503 from a draining replica is an ANSWER, not a
+        failure). Returns lifecycle events for the caller to surface:
+        ``("ejected", rid)`` / ``("recovered", rid)``."""
+        now = self.clock()
+        events: List[Tuple[str, str]] = []
+        with self._lock:
+            r = self.replicas[rid]
+            r.last_probe_at = now
+            if ok:
+                state = str((body or {}).get("state", ""))
+                was_ejected = r.state == EJECTED
+                r.breaker.record_clean()
+                r.consecutive_failures = 0
+                r.backoff_s = 0.0
+                if state == READY:
+                    r.state = READY
+                elif state == DEGRADED:
+                    r.state = DEGRADED
+                elif state in (DRAINING, "stopped", "scheduler dead"):
+                    # answers, but is leaving: out of rotation without the
+                    # ejection machinery (no backoff — it may restart READY)
+                    r.state = DRAINING
+                else:  # "starting" or an unrecognized body
+                    r.state = UNKNOWN
+                if was_ejected and r.state in (READY, DEGRADED):
+                    events.append(("recovered", rid))
+                if body:
+                    r.itl_ewma_ms = float(body.get("itl_ewma_ms", 0.0) or 0.0)
+                    r.queue_depth = int(
+                        body.get("queue_depth", body.get("queued", 0)) or 0
+                    )
+                    r.active_slots = int(
+                        body.get("active_slots", body.get("active", 0)) or 0
+                    )
+                    r.free_pages = int(body.get("free_pages", 0) or 0)
+                    r.breaker_open = bool(body.get("breaker_open", False))
+                r.next_probe_at = now + self.probe_interval
+            else:
+                r.consecutive_failures += 1
+                tripped = r.breaker.record_fault()
+                if r.state == EJECTED:
+                    # still dead on a backed-off re-probe: double the wait
+                    r.backoff_s = min(r.backoff_s * 2.0, self.backoff_max_s)
+                    r.next_probe_at = now + r.backoff_s
+                elif tripped:
+                    r.state = EJECTED
+                    r.ejections += 1
+                    r.backoff_s = self.backoff_base_s
+                    r.next_probe_at = now + r.backoff_s
+                    events.append(("ejected", rid))
+                else:
+                    r.next_probe_at = now + self.probe_interval
+        return events
+
+    def observe_relay_failure(self, rid: str, reason: str = "") -> List[Tuple[str, str]]:
+        """A relay hit a dead connection: count it like a probe failure (the
+        relay IS evidence of unreachability) and schedule an immediate
+        re-probe so the registry converges faster than the probe interval."""
+        events = self.observe_probe(rid, ok=False)
+        with self._lock:
+            r = self.replicas[rid]
+            if r.state != EJECTED:
+                r.next_probe_at = self.clock()  # probe now, not next tick
+        return events
+
+    # --------------------------------------------------------------- queries
+
+    def due(self, now: Optional[float] = None) -> List[Replica]:
+        """Replicas whose next probe is due (ejected ones respect their
+        exponential backoff; everyone else the base interval)."""
+        t = self.clock() if now is None else now
+        with self._lock:
+            return [r for r in self.replicas.values() if r.next_probe_at <= t]
+
+    def routable(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self.replicas.values() if r.routable]
+
+    def get(self, rid: str) -> Replica:
+        return self.replicas[rid]
+
+    # -------------------------------------------------- router-side bookkeeping
+
+    def cordon(self, rid: str) -> None:
+        with self._lock:
+            self.replicas[rid].cordoned = True
+
+    def uncordon(self, rid: str) -> None:
+        with self._lock:
+            self.replicas[rid].cordoned = False
+
+    def inc_relay(self, rid: str) -> None:
+        with self._lock:
+            r = self.replicas[rid]
+            r.active_relays += 1
+            r.requests_routed += 1
+
+    def dec_relay(self, rid: str) -> None:
+        with self._lock:
+            self.replicas[rid].active_relays -= 1
+
+    def add_tokens(self, rid: str, n: int) -> None:
+        with self._lock:
+            self.replicas[rid].tokens_relayed += n
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                r.id: {
+                    "url": r.url,
+                    "state": r.state,
+                    "cordoned": r.cordoned,
+                    "consecutive_failures": r.consecutive_failures,
+                    "ejections": r.ejections,
+                    "backoff_s": r.backoff_s,
+                    "itl_ewma_ms": r.itl_ewma_ms,
+                    "queue_depth": r.queue_depth,
+                    "active_slots": r.active_slots,
+                    "free_pages": r.free_pages,
+                    "active_relays": r.active_relays,
+                    "tokens_relayed": r.tokens_relayed,
+                    "requests_routed": r.requests_routed,
+                }
+                for r in self.replicas.values()
+            }
+
+
+# ------------------------------------------------------------ routing policy
+
+
+def chunk_prefix_key(
+    tokens: Optional[Sequence[int]], chunk_tokens: int
+) -> Optional[Tuple[int, ...]]:
+    """The affinity key: the prompt's LONGEST chunk-aligned token prefix —
+    the exact granularity the per-replica prefix cache banks K/V at
+    (``prefix_cache.py`` keys entries by whole chunk-aligned prefixes), so
+    "same key" really means "that replica has reusable K/V". Prompts
+    shorter than one chunk have nothing cacheable to be affine to."""
+    if tokens is None or chunk_tokens < 1:
+        return None
+    n = (len(tokens) // chunk_tokens) * chunk_tokens
+    if n == 0:
+        return None
+    return tuple(int(t) for t in tokens[:n])
+
+
+# PrefixAffinity keys levels by (length, rolling hash) instead of the prefix
+# tuple itself: recording L/chunk levels of materialized tuples is O(L^2)
+# time and memory per long prompt; one rolling-hash sweep is O(L) total.
+# A collision (~2^-61 birthday odds at LRU capacity) merely routes one
+# request to a replica without the prefix — a cache miss, never corruption.
+_HASH_MOD = (1 << 61) - 1
+_HASH_BASE = 1_000_003
+
+
+def _level_keys(
+    tokens: Optional[Sequence[int]], chunk_tokens: int
+) -> List[Tuple[int, int]]:
+    """(n_tokens, prefix_hash) for every chunk-aligned prefix of ``tokens``,
+    deepest first, in one O(len) pass."""
+    if tokens is None or chunk_tokens < 1:
+        return []
+    n = (len(tokens) // chunk_tokens) * chunk_tokens
+    if n == 0:
+        return []
+    out: List[Tuple[int, int]] = []
+    h = 0
+    for i in range(n):
+        h = (h * _HASH_BASE + int(tokens[i]) + 1) % _HASH_MOD
+        if (i + 1) % chunk_tokens == 0:
+            out.append((i + 1, h))
+    out.reverse()
+    return out
+
+
+class PrefixAffinity:
+    """Bounded LRU of chunk-aligned prefix keys -> the replica that served
+    them last, with LONGEST-match lookup: a route records every aligned
+    prefix level of the prompt (``tokens[:chunk]``, ``tokens[:2*chunk]``,
+    ...), and a lookup walks its own levels deepest-first — so two prompts
+    sharing a system prefix but diverging in their tails still land on the
+    same replica (the one whose prefix cache holds the shared chunks).
+    Host-side bookkeeping only; a stale entry is harmless (the pick falls
+    back to least-loaded when the remembered replica is unhealthy)."""
+
+    def __init__(self, chunk_tokens: int, capacity: int = 4096):
+        self.chunk_tokens = max(0, int(chunk_tokens))
+        self.capacity = max(1, int(capacity))
+        self._map: "OrderedDict[Tuple[int, int], str]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def _levels(
+        self, tokens: Optional[Sequence[int]]
+    ) -> List[Tuple[int, int]]:
+        """Every chunk-aligned prefix level of ``tokens`` as an O(1)-sized
+        (length, hash) key, deepest first."""
+        return _level_keys(tokens, self.chunk_tokens)
+
+    def lookup(self, tokens: Optional[Sequence[int]]) -> Optional[str]:
+        with self._lock:
+            for key in self._levels(tokens):
+                rid = self._map.get(key)
+                if rid is not None:
+                    self._map.move_to_end(key)
+                    return rid
+        return None
+
+    def record(self, tokens: Optional[Sequence[int]], rid: str) -> None:
+        with self._lock:
+            for key in self._levels(tokens):
+                self._map[key] = rid
+                self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def forget_replica(self, rid: str) -> None:
+        """Drop every affinity pointing at a replica (its cache is gone:
+        ejection, reload — the next request should re-spread, not chase a
+        cold or dead replica)."""
+        with self._lock:
+            for key in [k for k, v in self._map.items() if v == rid]:
+                del self._map[key]
+
+
+def pick_replica(
+    candidates: Sequence[Replica], affinity_id: Optional[str] = None
+) -> Optional[Replica]:
+    """The routing decision, pure: READY beats DEGRADED (a DEGRADED replica
+    serves only when nothing READY exists — it is mid-rebuild and slow);
+    within the chosen tier, prefix affinity wins (its K/V is there), else
+    least-loaded by ``Replica.load_score``. Deterministic for tests."""
+    ready = [c for c in candidates if c.state == READY]
+    pool = ready or [c for c in candidates if c.state == DEGRADED]
+    if not pool:
+        return None
+    if affinity_id is not None:
+        for c in pool:
+            if c.id == affinity_id:
+                return c
+    return min(pool, key=Replica.load_score)
+
+
+# ------------------------------------------------------------------- server
+
+
+class _HopDead(Exception):
+    """The current replica hop failed in a way failover should handle."""
+
+
+class RouterServer:
+    """The router process: HTTP front end + health-probe loop + relay core.
+
+    Endpoints (mirroring the replica surface where it makes sense):
+
+    - ``POST /generate``: relayed to a replica chosen by the routing
+      policy; SSE streams pass through token-by-token with mid-stream
+      failover; JSON (non-stream) requests retry wholesale on failure.
+    - ``GET /healthz``: 200 while >= 1 replica is routable; body carries
+      the full per-replica registry snapshot (states, failures, load).
+    - ``GET /metrics``: JSON snapshot, or Prometheus text exposition under
+      the same content negotiation as the replica server.
+    - ``POST /admin/reload``: rolling fleet reload (loopback/bearer-token
+      gated like the replica admin surface).
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[str],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        probe_interval: float = 0.25,
+        probe_timeout: float = 1.0,
+        eject_threshold: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 8.0,
+        chunk_tokens: int = 8,
+        affinity_capacity: int = 4096,
+        max_attempts: int = 3,
+        retry_backoff_s: float = 0.05,
+        connect_timeout: float = 2.0,
+        stream_timeout: float = 30.0,
+        max_body_bytes: int = 1 << 20,
+        admin_token: Optional[str] = None,
+        obs_dir: Optional[str] = None,
+        trace: bool = True,
+        trace_capacity: int = 8192,
+        clock=time.monotonic,
+    ):
+        self.clock = clock
+        self.probe_timeout = probe_timeout
+        self.max_attempts = max(1, int(max_attempts))
+        self.retry_backoff_s = retry_backoff_s
+        self.connect_timeout = connect_timeout
+        self.stream_timeout = stream_timeout
+        self.max_body_bytes = max_body_bytes
+        self.admin_token = admin_token
+        self.registry = ReplicaRegistry(
+            replicas, clock=clock, probe_interval=probe_interval,
+            eject_threshold=eject_threshold, backoff_base_s=backoff_base_s,
+            backoff_max_s=backoff_max_s,
+        )
+        self.affinity = PrefixAffinity(chunk_tokens, affinity_capacity)
+        self.stats: Dict[str, int] = {
+            "requests": 0,
+            "streams": 0,
+            "json_requests": 0,
+            "tokens_relayed": 0,
+            "routed": 0,
+            "retries": 0,
+            "failovers": 0,
+            "resumed_streams": 0,
+            "aborted_streams": 0,
+            "dropped_streams": 0,
+            "client_disconnects": 0,
+            "rejected_no_replica": 0,
+            "rejected_invalid": 0,
+            "affinity_hits": 0,
+            "affinity_misses": 0,
+            "probes": 0,
+            "probe_failures": 0,
+            "ejections": 0,
+            "recoveries": 0,
+            "rolling_reloads": 0,
+            "reload_steps": 0,
+            "reload_failures": 0,
+        }
+        # handler threads bump stats concurrently; += on a dict entry is a
+        # read-modify-write, so every increment goes through _bump
+        self._stats_lock = threading.Lock()
+        self.obs_dir = str(obs_dir) if obs_dir else None
+        self.tracer = Tracer(enabled=trace, capacity=trace_capacity, clock=clock)
+        self.metrics = Registry()
+        self.flight = FlightRecorder(
+            directory=self.obs_dir, tracer=self.tracer, clock=clock
+        )
+        self._register_exports()
+        self._stop = threading.Event()
+        self._reload_busy = threading.Lock()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="router-probe", daemon=True
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def _json(self, code: int, obj, headers=None) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    self._json(*outer._healthz())
+                elif path == "/metrics":
+                    accept = self.headers.get("Accept") or ""
+                    if (
+                        "format=prometheus" in query
+                        or "text/plain" in accept
+                        or "openmetrics" in accept
+                    ):
+                        body = outer.metrics.render().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._json(200, outer.metrics_snapshot())
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):  # noqa: N802
+                if self.path not in ("/generate", "/admin/reload"):
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self._json(400, {"error": "bad Content-Length"})
+                    return
+                if length < 0:
+                    self._json(400, {"error": "bad Content-Length"})
+                    return
+                if length > outer.max_body_bytes:
+                    self.close_connection = True
+                    self._json(413, {
+                        "error": f"body exceeds {outer.max_body_bytes} bytes",
+                    })
+                    return
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self._json(400, {"error": "malformed JSON body"})
+                    return
+                if not isinstance(req, dict):
+                    self._json(400, {"error": "body must be a JSON object"})
+                    return
+                if self.path == "/admin/reload":
+                    if not outer._admin_allowed(self):
+                        self._json(403, {"error": "admin endpoint: loopback "
+                                                  "or bearer token required"})
+                        return
+                    self._json(*outer._admin_reload(req))
+                else:
+                    outer._generate(self, req)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self, probe: bool = True) -> None:
+        if probe and not self._probe_thread.ident:
+            self._probe_thread.start()
+        self._server_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="router-http", daemon=True
+        )
+        self._server_thread.start()
+
+    def serve_forever(self) -> None:
+        if not self._probe_thread.ident:
+            self._probe_thread.start()
+        try:
+            self._httpd.serve_forever()
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()  # release the listening socket
+
+    def wait_ready(self, timeout: float = 10.0) -> bool:
+        """Block until at least one replica is routable (first probes have
+        landed) or the timeout expires."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.registry.routable():
+                return True
+            time.sleep(0.01)
+        return bool(self.registry.routable())
+
+    # --------------------------------------------------------------- probing
+
+    def _probe_loop(self) -> None:
+        tick = min(self.registry.probe_interval / 4.0, 0.05)
+        while not self._stop.wait(tick):
+            for rep in self.registry.due():
+                if self._stop.is_set():
+                    return
+                self.probe_once(rep.id)
+
+    def probe_once(self, rid: str) -> bool:
+        """One /healthz probe of one replica; folds the outcome into the
+        registry and surfaces ejection/recovery events."""
+        rep = self.registry.get(rid)
+        self._bump("probes")
+        ok, code, body = False, None, None
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.probe_timeout
+            )
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            code = resp.status
+            body = json.loads(resp.read() or b"{}")
+            ok = isinstance(body, dict)
+        except (OSError, ValueError, http.client.HTTPException):
+            ok = False
+        finally:
+            if conn is not None:
+                conn.close()
+        if not ok:
+            self._bump("probe_failures")
+        self._registry_events(self.registry.observe_probe(rid, ok, code, body))
+        return ok
+
+    def _registry_events(self, events: List[Tuple[str, str]]) -> None:
+        for name, rid in events:
+            if name == "ejected":
+                self._bump("ejections")
+                self.affinity.forget_replica(rid)
+                self.flight.event("replica_ejected", replica=rid)
+                # the post-mortem window: what the fleet looked like when
+                # the replica dropped out (probe history, relay counters)
+                self.flight.dump(
+                    f"replica_ejected_{rid.replace(':', '_')}",
+                    extra={"replica": rid, "registry": self.registry.snapshot()},
+                )
+            elif name == "recovered":
+                self._bump("recoveries")
+                self.flight.event("replica_recovered", replica=rid)
+
+    # --------------------------------------------------------------- routing
+
+    def _route(
+        self, tokens: Optional[Sequence[int]], exclude: Set[str]
+    ) -> Optional[Replica]:
+        candidates = [
+            r for r in self.registry.routable() if r.id not in exclude
+        ]
+        chunk = self.affinity.chunk_tokens
+        affine = tokens is not None and chunk >= 1 and len(tokens) >= chunk
+        aff = self.affinity.lookup(tokens)
+        rep = pick_replica(candidates, aff)
+        if rep is not None:
+            if affine:
+                if aff == rep.id:
+                    self._bump("affinity_hits")
+                else:
+                    self._bump("affinity_misses")
+                self.affinity.record(tokens, rep.id)
+            self._bump("routed")
+        return rep
+
+    # ---------------------------------------------------------------- health
+
+    def _healthz(self):
+        routable = self.registry.routable()
+        alive = self._probe_thread.is_alive() or not self._probe_thread.ident
+        ok = bool(routable) and alive
+        return (200 if ok else 503), {
+            "status": "ok" if ok else (
+                "no_routable_replicas" if alive else "probe thread dead"
+            ),
+            "routable": len(routable),
+            "replicas": self.registry.snapshot(),
+            "rolling_reload_active": self._reload_busy.locked(),
+        }
+
+    def _admin_allowed(self, handler) -> bool:
+        peer = handler.client_address[0]
+        if peer in ("127.0.0.1", "::1", "::ffff:127.0.0.1"):
+            return True
+        if self.admin_token:
+            auth = handler.headers.get("Authorization", "")
+            return auth == f"Bearer {self.admin_token}"
+        return False
+
+    # --------------------------------------------------------------- metrics
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            snap: Dict[str, Any] = dict(self.stats)
+        aff_total = snap["affinity_hits"] + snap["affinity_misses"]
+        snap["routable_replicas"] = len(self.registry.routable())
+        snap["affinity_hit_rate"] = (
+            snap["affinity_hits"] / aff_total if aff_total else 0.0
+        )
+        snap["replicas"] = self.registry.snapshot()
+        return snap
+
+    def _register_exports(self) -> None:
+        reg = self.metrics
+        for key, help_text in (
+            ("requests", "Requests received by the router"),
+            ("tokens_relayed", "Tokens relayed to clients"),
+            ("routed", "Routing decisions made"),
+            ("retries", "Pre-stream retries (connect/5xx/backpressure)"),
+            ("failovers", "Replica failovers (mid-stream + pre-stream)"),
+            ("resumed_streams", "Streams resumed on a survivor mid-generation"),
+            ("aborted_streams", "Streams terminated with a retryable error event"),
+            ("dropped_streams", "Streams left without a terminal event (must stay 0)"),
+            ("client_disconnects", "Client-side disconnects mid-stream"),
+            ("rejected_no_replica", "Requests rejected: no routable replica"),
+            ("affinity_hits", "Prefix-affinity routing hits"),
+            ("affinity_misses", "Prefix-affinity routing misses"),
+            ("probes", "Health probes sent"),
+            ("probe_failures", "Health probes that failed"),
+            ("ejections", "Replica ejections"),
+            ("recoveries", "Replica recoveries after ejection"),
+            ("rolling_reloads", "Rolling fleet reloads started"),
+            ("reload_steps", "Per-replica rolling-reload steps completed"),
+            ("reload_failures", "Per-replica rolling-reload steps failed"),
+        ):
+            reg.counter_func(
+                f"router_{key}", help_text, (lambda k=key: self.stats[k])
+            )
+        reg.gauge_func(
+            "router_routable_replicas", "Replicas currently in rotation",
+            lambda: len(self.registry.routable()),
+        )
+        # the four per-replica families share ONE registry snapshot per
+        # scrape: render() calls the callbacks in registration order, so the
+        # first (router_replica_up) refreshes the cell and the other three
+        # reuse it — keep these four registrations together and in order
+        snap_cell: Dict[str, Any] = {}
+
+        def fleet(refresh: bool = False) -> Dict[str, Any]:
+            if refresh or "snap" not in snap_cell:
+                snap_cell["snap"] = self.registry.snapshot()
+            return snap_cell["snap"]
+
+        reg.gauge_func(
+            "router_replica_up", "1 while the replica is in rotation",
+            lambda: [
+                ({"replica": rid}, 1 if info["state"] in (READY, DEGRADED)
+                 and not info["cordoned"] else 0)
+                for rid, info in fleet(refresh=True).items()
+            ],
+        )
+        reg.gauge_func(
+            "router_replica_queue_depth", "Scraped per-replica queue depth",
+            lambda: [
+                ({"replica": rid}, info["queue_depth"])
+                for rid, info in fleet().items()
+            ],
+        )
+        reg.gauge_func(
+            "router_replica_active_relays",
+            "Router-side in-flight relays per replica",
+            lambda: [
+                ({"replica": rid}, info["active_relays"])
+                for rid, info in fleet().items()
+            ],
+        )
+        reg.counter_func(
+            "router_replica_tokens_relayed", "Tokens relayed per replica",
+            lambda: [
+                ({"replica": rid}, info["tokens_relayed"])
+                for rid, info in fleet().items()
+            ],
+        )
+
+    # ----------------------------------------------------------------- relay
+
+    def _connect(self, rep: Replica) -> http.client.HTTPConnection:
+        """Connect with the short connect timeout, then widen the socket
+        timeout to the stream budget (a healthy replica may legitimately
+        take longer between tokens than it may take to accept a TCP
+        connection)."""
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self.connect_timeout
+        )
+        conn.connect()
+        conn.sock.settimeout(self.stream_timeout)
+        return conn
+
+    def _post_replica(
+        self, rep: Replica, path: str, body: dict,
+        rid: Optional[str] = None, timeout: Optional[float] = None,
+    ) -> Tuple[int, dict]:
+        """Small JSON POST helper (admin + probe paths, not the relay)."""
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=timeout or self.stream_timeout
+        )
+        try:
+            headers = {"Content-Type": "application/json"}
+            if rid:
+                headers["X-Request-Id"] = rid
+            conn.request("POST", path, json.dumps(body), headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            try:
+                doc = json.loads(payload or b"{}")
+            except ValueError:
+                doc = {"error": "unparseable replica response"}
+            # the replica advertises its backoff as an HTTP header, not a
+            # body field — fold it in so _retry_after_of sees it
+            ra = resp.getheader("Retry-After")
+            if ra is not None and "retry_after" not in doc:
+                doc["retry_after"] = ra
+            return resp.status, doc
+        finally:
+            conn.close()
+
+    def _generate(self, handler, req: dict) -> None:
+        rid = _clean_rid(
+            handler.headers.get("X-Request-Id") or req.get("request_id")
+        )
+        self._bump("requests")
+        tokens = req.get("tokens")
+        if tokens is not None:
+            try:
+                tokens = [int(t) for t in tokens]
+                req = {**req, "tokens": tokens}
+            except (TypeError, ValueError):
+                self._bump("rejected_invalid")
+                handler._json(400, {"error": "tokens must be integers",
+                                    "request_id": rid},
+                              headers={"X-Request-Id": rid})
+                return
+        # the numeric fields the ROUTER itself does arithmetic on (resume
+        # budgets, deadline shrinking) must parse here: a malformed value
+        # raising mid-relay would tear the connection with no response and
+        # pollute dropped_streams — the counter the chaos proofs pin to 0
+        try:
+            req = {**req, "max_new_tokens": int(req.get("max_new_tokens", 32))}
+            if "timeout" in req:
+                req["timeout"] = float(req["timeout"])
+        except (TypeError, ValueError):
+            self._bump("rejected_invalid")
+            handler._json(400, {
+                "error": "max_new_tokens/timeout must be numeric",
+                "request_id": rid,
+            }, headers={"X-Request-Id": rid})
+            return
+        if req.get("stream", True):
+            self._bump("streams")
+            state = {"ids": [], "texts": [], "terminal": False,
+                     "headers_sent": False, "failover_count": 0}
+            try:
+                self._relay_stream(handler, req, rid, state)
+            finally:
+                if not state["terminal"]:
+                    # every exit path above must have delivered a terminal
+                    # event (done, error event, or observed client
+                    # disconnect); anything else is a DROPPED stream — the
+                    # counter the chaos proofs pin to zero
+                    self._bump("dropped_streams")
+        else:
+            self._bump("json_requests")
+            self._relay_json(handler, req, rid)
+
+    # ---- JSON (non-stream) relay: nothing reaches the client until the
+    # replica's full response is in hand, so every failure mode is a safe
+    # wholesale retry on another replica.
+
+    def _relay_json(self, handler, req: dict, rid: str) -> None:
+        t0 = self.clock()
+        tried: Set[str] = set()
+        retry_after = 1.0
+        last_error = "no routable replica"
+        for attempt in range(self.max_attempts):
+            rep = self._route(req.get("tokens"), tried)
+            if rep is None:
+                break
+            tried.add(rep.id)
+            self.registry.inc_relay(rep.id)
+            hop0 = self.clock()
+            status, doc, dead = None, None, None
+            try:
+                code_doc = self._post_replica(rep, "/generate", req, rid=rid)
+                status, doc = code_doc
+            except (OSError, http.client.HTTPException) as exc:
+                dead = f"{type(exc).__name__}: {exc}"
+            finally:
+                self.registry.dec_relay(rep.id)
+                self.tracer.add("relay", rid, hop0, self.clock(), {
+                    "replica": rep.id, "mode": "json",
+                    "status": status if status is not None else "dead",
+                })
+            if dead is not None:
+                self._registry_events(
+                    self.registry.observe_relay_failure(rep.id, dead)
+                )
+                self._bump("failovers")
+                last_error = f"replica {rep.id} failed: {dead}"
+                time.sleep(self.retry_backoff_s * (2 ** attempt))
+                continue
+            if status in (429, 503):
+                retry_after = max(retry_after, _retry_after_of(doc))
+                self._bump("retries")
+                last_error = str(doc.get("error", f"replica {status}"))
+                continue
+            if status >= 500:
+                # replica-side failure (500/502/504...): nothing reached the
+                # client — retry elsewhere, with suspicion like a dead socket
+                self._registry_events(
+                    self.registry.observe_relay_failure(
+                        rep.id, f"replica {status}"
+                    )
+                )
+                self._bump("failovers")
+                last_error = str(doc.get("error", f"replica {status}"))
+                time.sleep(self.retry_backoff_s * (2 ** attempt))
+                continue
+            if status == 200 and doc.get("status") == "failed":
+                # the replica admitted, then its engine failed the request
+                # retryably (tick fault); nothing reached the client — retry
+                self._bump("failovers")
+                last_error = str(doc.get("error", "replica engine failure"))
+                continue
+            n_tokens = len(doc.get("tokens") or ())
+            self.registry.add_tokens(rep.id, n_tokens)
+            self._bump("tokens_relayed", n_tokens)
+            doc["request_id"] = rid
+            doc["replica"] = rep.id
+            self._finish_trace(rid, t0, doc.get("status", str(status)),
+                               failovers=len(tried) - 1)
+            handler._json(status, doc, headers={"X-Request-Id": rid})
+            return
+        self._bump("rejected_no_replica")
+        self._finish_trace(rid, t0, "rejected", failovers=max(0, len(tried) - 1))
+        handler._json(503, {
+            "error": last_error, "status": "rejected", "request_id": rid,
+        }, headers={
+            "Retry-After": str(max(1, math.ceil(retry_after))),
+            "X-Request-Id": rid,
+        })
+
+    # ---- SSE relay with mid-stream failover.
+
+    def _relay_stream(self, handler, req: dict, rid: str, state: dict) -> None:
+        t0 = self.clock()
+        orig_tokens = req.get("tokens")
+        max_new = int(req.get("max_new_tokens", 32))
+        tried: Set[str] = set()
+        retry_after = 1.0
+        last_error = "no routable replica"
+        attempt = 0
+        while attempt < self.max_attempts:
+            relayed = len(state["ids"])
+            rep = self._route(orig_tokens, tried)
+            if rep is None:
+                break
+            attempt += 1
+            tried.add(rep.id)
+            body = self._hop_body(req, state["ids"], self.clock() - t0)
+            self.registry.inc_relay(rep.id)
+            hop0 = self.clock()
+            hop_tokens_before = relayed
+            conn = None
+            outcome, detail = "dead", "connect"
+            finish_done = None
+            abort_error = None
+            try:
+                try:
+                    conn = self._connect(rep)
+                    conn.request(
+                        "POST", "/generate", json.dumps(body),
+                        {"Content-Type": "application/json",
+                         "X-Request-Id": rid},
+                    )
+                    resp = conn.getresponse()
+                except (OSError, http.client.HTTPException) as exc:
+                    raise _HopDead(f"connect: {type(exc).__name__}: {exc}")
+                if resp.status != 200:
+                    payload = resp.read()
+                    try:
+                        doc = json.loads(payload or b"{}")
+                    except ValueError:
+                        doc = {}
+                    ra = resp.getheader("Retry-After")
+                    if ra is not None and "retry_after" not in doc:
+                        doc["retry_after"] = ra
+                    if resp.status in (429, 503):
+                        # backpressure/drain: honest retry elsewhere, the
+                        # replica is alive — no suspicion, no failover count
+                        retry_after = max(retry_after, _retry_after_of(doc))
+                        self._bump("retries")
+                        last_error = str(doc.get("error", f"replica {resp.status}"))
+                        outcome, detail = "backpressure", str(resp.status)
+                        continue
+                    if resp.status >= 500:
+                        # replica-side failure before any stream bytes
+                        # (500/502/504...): silently try the next replica,
+                        # with suspicion — repeated 5xx should eject
+                        outcome, detail = "replica_5xx", str(resp.status)
+                        raise _HopDead(
+                            f"replica {resp.status}: "
+                            f"{doc.get('error', 'server error')}"
+                        )
+                    # client error (400 etc): the request itself is bad —
+                    # forward verbatim, no retry can fix it
+                    outcome, detail = "client_error", str(resp.status)
+                    if not state["headers_sent"]:
+                        doc.setdefault("request_id", rid)
+                        try:
+                            handler._json(resp.status, doc,
+                                          headers={"X-Request-Id": rid})
+                        except (BrokenPipeError, ConnectionResetError,
+                                OSError):
+                            self._bump("client_disconnects")
+                        state["terminal"] = True
+                    else:
+                        self._finish_stream(
+                            handler, rid, state, t0, "failed",
+                            str(doc.get("error", f"replica {resp.status}")),
+                            retryable=False,
+                        )
+                    return
+                if not state["headers_sent"]:
+                    try:
+                        handler.send_response(200)
+                        handler.send_header(
+                            "Content-Type", "text/event-stream"
+                        )
+                        handler.send_header("Cache-Control", "no-cache")
+                        handler.send_header("X-Request-Id", rid)
+                        handler.end_headers()
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        # the client left while we were still setting up:
+                        # an ordinary disconnect, not a dropped stream
+                        self._bump("client_disconnects")
+                        state["terminal"] = True
+                        outcome, detail = "client_gone", "headers"
+                        return
+                    state["headers_sent"] = True
+                kind, payload = self._pump_sse(resp, handler, state)
+                if kind == "client_gone":
+                    self._bump("client_disconnects")
+                    state["terminal"] = True
+                    outcome, detail = "client_gone", ""
+                    return
+                if kind == "done":
+                    status = str(payload.get("status", "done"))
+                    if status == "failed" and payload.get("retryable", True):
+                        # the replica's engine failed this request retryably
+                        # (tick fault / poisoned slot): a clean SSE ending,
+                        # but the generation is incomplete — fail over with
+                        # what was already relayed
+                        last_error = str(payload.get("error", "replica engine failure"))
+                        outcome, detail = "engine_failed", last_error
+                        raise _HopDead(last_error)
+                    # finish AFTER the finally's bookkeeping: the terminal
+                    # event is the client's cue that stats/spans are final
+                    outcome, detail = "done", status
+                    finish_done = (
+                        status, payload.get("error"),
+                        bool(payload.get("retryable", False)),
+                    )
+                else:
+                    # kind == "dead": mid-stream death (EOF/reset/timeout/torn)
+                    raise _HopDead(str(payload))
+            except _HopDead as exc:
+                last_error = str(exc)
+                self._bump("failovers")
+                state["failover_count"] += 1
+                if outcome in ("dead", "replica_5xx"):
+                    self._registry_events(
+                        self.registry.observe_relay_failure(rep.id, last_error)
+                    )
+                if outcome == "dead":
+                    # the survivor taking over also takes over the prefix
+                    # (a 5xx answer means the replica — and its prefix
+                    # cache — is still alive, so affinity stays)
+                    self.affinity.forget_replica(rep.id)
+                if state["ids"] and len(state["ids"]) >= max_new:
+                    # died between its last token and the done event — the
+                    # budget is spent, nothing left to resume: the client
+                    # has the whole generation, so it IS done (via the
+                    # post-finally finish, not here, so the dead hop's
+                    # bookkeeping lands before the terminal write)
+                    finish_done = ("done", None, False)
+                elif state["ids"] and orig_tokens is None:
+                    # non-resumable: the router cannot reconstruct the token
+                    # prompt a text request was tokenized into, and tokens
+                    # already reached the client — degrade gracefully into a
+                    # retryable terminal error, never a hang (written after
+                    # the finally's bookkeeping, like every terminal event)
+                    abort_error = (
+                        f"replica failed mid-stream and the text prompt is "
+                        f"not resumable ({last_error})"
+                    )
+                else:
+                    if state["ids"]:
+                        # a resume hop is about to dispatch; it only counts
+                        # as a resumed stream once a survivor actually
+                        # completes it (see _finish_stream) — not on the
+                        # attempt
+                        state["was_resumed"] = True
+                    time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+                    continue
+            finally:
+                if conn is not None:
+                    conn.close()
+                self.registry.dec_relay(rep.id)
+                hop_n = len(state["ids"]) - hop_tokens_before
+                self.registry.add_tokens(rep.id, hop_n)
+                self.tracer.add("relay", rid, hop0, self.clock(), {
+                    "replica": rep.id, "tokens": hop_n,
+                    "resumed": hop_tokens_before > 0,
+                    "outcome": outcome, "detail": detail,
+                })
+            if finish_done is not None:
+                self._finish_stream(
+                    handler, rid, state, t0, finish_done[0], finish_done[1],
+                    retryable=finish_done[2],
+                )
+                return
+            if abort_error is not None:
+                self._bump("aborted_streams")
+                self._finish_stream(
+                    handler, rid, state, t0, "failed", abort_error,
+                    retryable=True,
+                )
+                return
+        # retry budget exhausted / nothing routable
+        if state["headers_sent"]:
+            self._bump("aborted_streams")
+            self._finish_stream(
+                handler, rid, state, t0, "failed",
+                f"retry budget exhausted: {last_error}", retryable=True,
+            )
+        else:
+            self._bump("rejected_no_replica")
+            state["terminal"] = True
+            self._finish_trace(rid, t0, "rejected", 0)
+            handler._json(503, {
+                "error": last_error, "status": "rejected", "request_id": rid,
+            }, headers={
+                "Retry-After": str(max(1, math.ceil(retry_after))),
+                "X-Request-Id": rid,
+            })
+
+    def _hop_body(
+        self, req: dict, relayed: List[int], elapsed: float
+    ) -> dict:
+        """The request body for this hop: verbatim on the first dispatch; on
+        a resume, prompt = original tokens + everything already relayed,
+        budget reduced by the same amount (the seed rides along — greedy
+        continues the exact trajectory, seeded sampling a consistent one),
+        and any client deadline shrunk by the time already spent."""
+        body = dict(req)
+        body.pop("request_id", None)
+        if relayed:
+            body["tokens"] = list(req["tokens"]) + list(relayed)
+            body.pop("prompt", None)
+            body["max_new_tokens"] = (
+                int(req.get("max_new_tokens", 32)) - len(relayed)
+            )
+        if "timeout" in req:
+            body["timeout"] = max(0.05, float(req["timeout"]) - elapsed)
+        return body
+
+    def _pump_sse(self, resp, handler, state: dict):
+        """Relay SSE events replica -> client until the done event, the
+        stream dies, or the client leaves. Token events forward as raw bytes
+        (one readline + one write per token); every forwarded token id is
+        recorded in ``state`` — that record IS the resume point."""
+        while True:
+            try:
+                line = resp.readline()
+            except (OSError, http.client.HTTPException) as exc:
+                return "dead", f"read: {type(exc).__name__}: {exc}"
+            if not line:
+                return "dead", "stream ended before the done event"
+            if not line.strip():
+                continue  # SSE event separator
+            if not line.startswith(b"data: "):
+                continue
+            try:
+                event = json.loads(line[6:])
+            except ValueError:
+                return "dead", "torn SSE event"
+            if event.get("done"):
+                return "done", event
+            try:
+                handler.wfile.write(line.rstrip(b"\r\n") + b"\n\n")
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return "client_gone", None
+            if "token" in event:
+                state["ids"].append(int(event["token"]))
+                self._bump("tokens_relayed")
+            if event.get("text"):
+                state["texts"].append(str(event["text"]))
+
+    def _finish_stream(
+        self, handler, rid: str, state: dict, t0: float, status: str,
+        error: Optional[str], retryable: bool = False,
+    ) -> None:
+        """The terminal SSE event is always ROUTER-built: accumulated text
+        across every hop (a resumed stream's per-replica done event only
+        knows its own segment), the failover count, and the correlation id."""
+        event: Dict[str, Any] = {
+            "done": True,
+            "status": status,
+            "text": "".join(state["texts"]),
+            "request_id": rid,
+            "failovers": state.get("failover_count", 0),
+        }
+        if error:
+            event["error"] = error
+            event["retryable"] = retryable
+        # bookkeeping BEFORE the terminal write: the done event is the
+        # client's cue that the stream is settled, so a client that reads it
+        # and immediately scrapes /metrics must see these counters landed
+        if status == "done" and state.get("was_resumed"):
+            # the survivor finished what a dead replica started: one resumed
+            # stream, however many hops the failover chain crossed
+            self._bump("resumed_streams")
+        state["terminal"] = True
+        self._finish_trace(rid, t0, status, event["failovers"])
+        try:
+            handler.wfile.write(b"data: " + json.dumps(event).encode() + b"\n\n")
+            handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            self._bump("client_disconnects")
+
+    def _finish_trace(
+        self, rid: str, t0: float, outcome: str, failovers: int
+    ) -> None:
+        if self.tracer.enabled:
+            self.tracer.add("route", rid, t0, self.clock(), {
+                "id": rid, "outcome": outcome, "failovers": failovers,
+            })
+
+    # --------------------------------------------------------- rolling reload
+
+    def _admin_reload(self, req: dict):
+        """(code, body) for POST /admin/reload on the ROUTER: a rolling
+        fleet reload. 409 while one is already running."""
+        if not self._reload_busy.acquire(blocking=False):
+            return 409, {"error": "rolling reload already in progress"}
+        try:
+            ok, steps = self._rolling_reload(
+                params_path=req.get("params"),
+                drain_timeout_s=float(req.get("drain_timeout", 30.0)),
+                ready_timeout_s=float(req.get("ready_timeout", 60.0)),
+            )
+            return (200 if ok else 502), {
+                "reloaded": ok,
+                "replicas": steps,
+                "dropped_streams": self.stats["dropped_streams"],
+            }
+        finally:
+            self._reload_busy.release()
+
+    def rolling_reload(
+        self,
+        params_path: Optional[str] = None,
+        drain_timeout_s: float = 30.0,
+        ready_timeout_s: float = 60.0,
+    ) -> Tuple[bool, List[Dict[str, Any]]]:
+        """Public in-process entry (the HTTP handler and tests share it)."""
+        if not self._reload_busy.acquire(blocking=False):
+            raise RuntimeError("rolling reload already in progress")
+        try:
+            return self._rolling_reload(params_path, drain_timeout_s,
+                                        ready_timeout_s)
+        finally:
+            self._reload_busy.release()
+
+    def _rolling_reload(
+        self,
+        params_path: Optional[str],
+        drain_timeout_s: float,
+        ready_timeout_s: float,
+    ) -> Tuple[bool, List[Dict[str, Any]]]:
+        """One replica at a time: cordon -> drain the router's in-flight
+        relays to it -> replica /admin/reload -> wait READY -> uncordon.
+        The fleet always keeps N-1 replicas taking traffic, and no stream
+        is ever cut: new requests route around the cordoned replica while
+        its in-flight ones finish at their own pace."""
+        self._bump("rolling_reloads")
+        self.flight.event("rolling_reload_begin", params=params_path or "")
+        results: List[Dict[str, Any]] = []
+        all_ok = True
+        for rid in list(self.registry.replicas):
+            rep = self.registry.get(rid)
+            if rep.state == EJECTED:
+                results.append({"replica": rid, "ok": False,
+                                "error": "ejected; nothing to reload"})
+                all_ok = False
+                continue
+            step: Dict[str, Any] = {"replica": rid, "ok": False}
+            t0 = self.clock()
+            self.registry.cordon(rid)
+            try:
+                if not self._await_zero_relays(rid, drain_timeout_s):
+                    step["error"] = (
+                        f"drain timeout: {rep.active_relays} relays still "
+                        f"in flight after {drain_timeout_s}s"
+                    )
+                    all_ok = False
+                    results.append(step)
+                    continue
+                drained_at = self.clock()
+                self.tracer.add("reload_drain", "router", t0, drained_at,
+                                {"replica": rid})
+                try:
+                    code, doc = self._post_replica(
+                        rep, "/admin/reload",
+                        {"params": params_path} if params_path else {},
+                    )
+                except (OSError, http.client.HTTPException) as exc:
+                    code, doc = 0, {"error": f"{type(exc).__name__}: {exc}"}
+                if code != 200:
+                    step["error"] = (
+                        f"replica reload returned {code}: "
+                        f"{doc.get('error', '')}"
+                    )
+                    self._bump("reload_failures")
+                    all_ok = False
+                    results.append(step)
+                    continue
+                if not self._await_ready(rid, ready_timeout_s):
+                    step["error"] = f"not READY within {ready_timeout_s}s"
+                    self._bump("reload_failures")
+                    all_ok = False
+                    results.append(step)
+                    continue
+                self.tracer.add("reload_swap", "router", drained_at,
+                                self.clock(), {"replica": rid})
+                # its prefix cache flushed on reload: old affinities point
+                # at K/V that no longer exists
+                self.affinity.forget_replica(rid)
+                self._bump("reload_steps")
+                self.flight.event("rolling_reload_step", replica=rid,
+                                  reloads=doc.get("reloads"))
+                step.update(ok=True, reloads=doc.get("reloads"),
+                            drained_s=round(drained_at - t0, 3))
+                results.append(step)
+            finally:
+                self.registry.uncordon(rid)
+        self.flight.event("rolling_reload_end", ok=all_ok)
+        return all_ok, results
+
+    def _await_zero_relays(self, rid: str, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.registry.get(rid).active_relays == 0:
+                return True
+            time.sleep(0.01)
+        return self.registry.get(rid).active_relays == 0
+
+    def _await_ready(self, rid: str, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.probe_once(rid)
+            if self.registry.get(rid).state == READY:
+                return True
+            time.sleep(0.05)
+        return False
+
+    # ----------------------------------------------------------------- misc
+
+    def export_trace(self, path: str) -> str:
+        return self.tracer.write_chrome_trace(path)
+
+
+def _retry_after_of(doc: dict) -> float:
+    try:
+        return float(doc.get("retry_after", 1.0) or 1.0)
+    except (TypeError, ValueError):
+        return 1.0
+
+
+def run_router(
+    replicas: Sequence[str],
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    background: bool = False,
+    **kwargs,
+) -> Optional[RouterServer]:
+    """Start the fleet router. ``background=True`` returns the running
+    router (tests); otherwise blocks until interrupted."""
+    router = RouterServer(replicas, host=host, port=port, **kwargs)
+    if background:
+        router.start()
+        return router
+    import signal
+
+    def on_term(signum, frame):
+        threading.Thread(target=router.stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_term)
+    print(
+        f"routing on http://{host}:{router.port} over "
+        f"{len(router.registry)} replicas — POST /generate, GET /healthz, "
+        "GET /metrics (JSON; Prometheus via Accept: text/plain), "
+        "POST /admin/reload (rolling fleet reload)",
+        flush=True,
+    )
+    router.serve_forever()
+    return None

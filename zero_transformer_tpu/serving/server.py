@@ -7,7 +7,10 @@ CUDA+gradio app (reference ``app.py``). Endpoints:
 - ``POST /generate``: JSON body ``{"prompt": str | "tokens": [int],
   "max_new_tokens": int, "seed": int, "timeout": float, "stream": bool}``.
   With ``stream`` (default true) the response is ``text/event-stream``: one
-  ``data: {"token": id, "text": piece}`` event per committed text piece and
+  ``data: {"token": id, "text": piece}`` event per token — ``"text"`` is
+  the empty string while the detokenizer buffers a piece mid-UTF-8, so
+  every token id is on the wire (the fleet router's mid-stream resume
+  point) and joining ``e["text"]`` still reconstructs the full text — and
   a final ``data: {"done": true, "status": ..., "text": full}``. Without, a
   single JSON document. Backpressure maps to HTTP 429 (queue full) / 400
   (invalid request).
@@ -255,6 +258,15 @@ class ServingServer:
             "active": self.engine.active_count,
             "prefilling": len(self.engine._prefilling),
             "queued": self.engine.queue_depth,
+            # the fleet router's admission inputs (ISSUE 9): everything its
+            # least-loaded policy needs rides the same cheap health poll —
+            # one GET instead of a /metrics scrape per routing refresh
+            "itl_ewma_ms": round(
+                (self.engine._itl_ewma.value or 0.0) * 1e3, 4
+            ),
+            "queue_depth": self.engine.queue_depth,
+            "active_slots": self.engine.active_count,
+            "free_pages": self.engine.free_pages,
         }
 
     def _admin_allowed(self, handler) -> bool:
@@ -477,6 +489,15 @@ class ServingServer:
                 if piece is not None:
                     pieces.append(piece)
                     self._event(handler, {"token": token, "text": piece})
+                else:
+                    # detok buffered the piece (partial UTF-8 across BPE
+                    # boundaries): the token id still goes on the wire —
+                    # the fleet router's mid-stream failover resumes from
+                    # the ids it relayed, and a resume prompt missing
+                    # buffered tokens would diverge even under greedy.
+                    # text stays PRESENT (empty) so ``e["text"]`` consumers
+                    # keep working and joins are unchanged
+                    self._event(handler, {"token": token, "text": ""})
             tail = decoder.flush()
             if tail is not None:
                 pieces.append(tail)
@@ -486,6 +507,9 @@ class ServingServer:
                 "status": handle.status,
                 "text": "".join(pieces),
                 "error": handle.error,
+                # the fleet router keys failover on this: a retryable
+                # failure mid-stream is resumed on another replica
+                "retryable": handle.retryable,
                 "request_id": handle.rid,
             })
         except (BrokenPipeError, ConnectionResetError):
