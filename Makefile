@@ -3,7 +3,15 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos obs bench bench-watch serve-bench train-bench e2e-watch fmt fmt-check dryrun
+.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos obs bench bench-watch serve-bench train-bench e2e-watch fmt fmt-check dryrun lint
+
+# Invariant lint lane (ISSUE 10): graftlint's repo-specific AST rules +
+# the suppression audit over the whole tree. Pure stdlib — no jax import,
+# no backend init — so it costs seconds. Exit 1 on any unsuppressed
+# finding; every suppression's reason is printed for review. The same
+# gate runs in the quick lane as test_graftlint.py::test_tree_is_clean.
+lint:
+	$(PY) scripts/graftlint.py --audit
 
 # Quick lane: everything but tests marked slow (multi-process jax.distributed,
 # long training loops, heavy cross-stage numerics). This is what CI runs on

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""graftlint CLI: run the repo's invariant rules over the tree.
+
+Usage:
+    python scripts/graftlint.py [paths...]            # lint (default tree)
+    python scripts/graftlint.py --audit               # + list suppressions
+    python scripts/graftlint.py --rule donation-safety path/to/file.py
+    python scripts/graftlint.py --json                # machine-readable
+
+Exit status: 0 when every finding is suppressed-with-a-reason, 1 otherwise.
+Loads the analyzer module directly by file path — no jax, no package
+``__init__`` chain — so the whole-tree pass costs seconds (single AST walk
+per file).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_static_rules():
+    path = REPO / "zero_transformer_tpu" / "analysis" / "static_rules.py"
+    spec = importlib.util.spec_from_file_location("graftlint_static", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolve types via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+DEFAULT_PATHS = [
+    "zero_transformer_tpu",
+    "scripts",
+    "train.py",
+    "bench.py",
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument(
+        "--rule",
+        action="append",
+        help="run only this rule (repeatable); default: all rules",
+    )
+    ap.add_argument(
+        "--audit",
+        action="store_true",
+        help="list every suppression with its reason (the audit trail)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    args = ap.parse_args(argv)
+
+    sr = _load_static_rules()
+    unknown = [r for r in (args.rule or []) if r not in sr.ALL_RULES]
+    if unknown:
+        # a typo'd rule name must not run zero rules and report "clean"
+        print(
+            f"graftlint: unknown rule(s) {', '.join(unknown)} "
+            f"(known: {', '.join(sr.ALL_RULES)})",
+            file=sys.stderr,
+        )
+        return 2
+    t0 = time.monotonic()
+    paths = [REPO / p for p in (args.paths or DEFAULT_PATHS)]
+    paths = [p for p in paths if p.exists()]
+    mesh_axes = sr.refresh_mesh_axes(REPO)
+    findings = sr.analyze_paths(paths, rules=args.rule, mesh_axes=mesh_axes)
+    for f in findings:
+        try:
+            f.path = str(Path(f.path).relative_to(REPO))
+        except ValueError:
+            pass
+    elapsed = time.monotonic() - t0
+
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "elapsed_s": round(elapsed, 3),
+                    "files": len(sr.iter_python_files(paths)),
+                    "active": [vars(f) for f in active],
+                    "suppressed": [vars(f) for f in suppressed],
+                },
+                indent=2,
+            )
+        )
+        return 1 if active else 0
+
+    for f in active:
+        print(f.format())
+    if args.audit:
+        if suppressed:
+            print(f"\n-- suppression audit ({len(suppressed)}) --")
+        for f in suppressed:
+            print(f"{f.path}:{f.line}: allow[{f.rule}] reason={f.reason}")
+    n_files = len(sr.iter_python_files(paths))
+    status = "clean" if not active else f"{len(active)} unsuppressed finding(s)"
+    print(
+        f"\ngraftlint: {n_files} files, {len(findings)} finding(s) "
+        f"({len(suppressed)} suppressed) in {elapsed:.2f}s -- {status}"
+    )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""True positive: a broad except inside a supervised seam that only logs —
+it swallows the supervisor's retryable-vs-fatal classification."""
+
+
+# graftlint: supervised-seam
+def tick(engine, log):
+    try:
+        engine.dispatch()
+    except Exception as exc:
+        log.warning("tick failed: %r", exc)

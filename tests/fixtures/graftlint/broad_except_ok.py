@@ -1,0 +1,10 @@
+"""Clean twin: the broad except hands the exception to the fault
+classifier, preserving the retryable-vs-fatal decision."""
+
+
+# graftlint: supervised-seam
+def tick(engine):
+    try:
+        engine.dispatch()
+    except Exception as exc:
+        engine.classify_fault(exc)

@@ -1,0 +1,10 @@
+"""True positive: a checkpoint-restored tree reaches a donating jit
+without an ``ensure_donatable`` seam (the jax 0.4.37 zero-copy class)."""
+import jax
+
+train_step = jax.jit(lambda state, batch: state, donate_argnums=(0,))
+
+
+def resume_and_step(ckptr, abstract, batch):
+    state = ckptr.restore(abstract)
+    return train_step(state, batch)

@@ -1,0 +1,8 @@
+"""True positive: ``.item()`` forces a device->host sync inside a
+hot-path-marked function (the per-tick/per-step no-sync budget)."""
+
+
+# graftlint: hot-path
+def tick(engine):
+    loss = engine.last_loss.item()
+    return loss

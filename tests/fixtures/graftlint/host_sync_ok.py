@@ -1,0 +1,8 @@
+"""Clean twin: the hot path dispatches and hands back futures — no
+``.item()`` / ``device_get`` / ``block_until_ready`` on the tick."""
+
+
+# graftlint: hot-path
+def tick(engine):
+    futures = engine.dispatch()
+    return futures

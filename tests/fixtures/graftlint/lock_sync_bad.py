@@ -1,0 +1,8 @@
+"""True positive: a blocking device op under the engine lock — every
+submit/scrape stalls for the sync's duration."""
+import jax
+
+
+def scrape(self):
+    with self.lock:
+        return jax.device_get(self.counters)

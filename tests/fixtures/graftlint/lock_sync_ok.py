@@ -1,0 +1,8 @@
+"""Clean twin: snapshot the reference under the lock, sync outside it."""
+import jax
+
+
+def scrape(self):
+    with self.lock:
+        snapshot = self.counters
+    return jax.device_get(snapshot)

@@ -1,0 +1,5 @@
+"""True positive: a PartitionSpec naming an axis no mesh declares — it
+would silently replicate (or fail deep inside pjit at first dispatch)."""
+from jax.sharding import PartitionSpec as P
+
+BATCH_SPEC = P("data", "bogus_axis")

@@ -1,0 +1,4 @@
+"""Clean twin: every named axis is a declared mesh axis, used once."""
+from jax.sharding import PartitionSpec as P
+
+BATCH_SPEC = P("data", "tensor")

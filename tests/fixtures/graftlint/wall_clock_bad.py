@@ -1,0 +1,7 @@
+"""True positive: ``time.time()`` where span/trace timestamps must ride
+one monotonic clock (NTP steps would tear the timeline)."""
+import time
+
+
+def span_stamp():
+    return time.time()

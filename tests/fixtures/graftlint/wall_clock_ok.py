@@ -1,0 +1,6 @@
+"""Clean twin: the span clock is monotonic."""
+import time
+
+
+def span_stamp():
+    return time.monotonic()

@@ -1,0 +1,60 @@
+"""graftlint: machine-checked enforcement of this repo's hard-won invariants.
+
+Nine PRs accumulated a set of rules that existed only as reviewer folklore:
+
+- donated buffers must pass through ``jax_compat.ensure_donatable`` (the
+  jax 0.4.37 zero-copy heap-corruption class fixed in PR 2 and re-fixed in
+  PR 5's multihost worker);
+- hot loops must not host-sync (PR 2/5's "zero per-step host sync", PR 4/7's
+  per-tick dispatch discipline);
+- every dispatch site must have a BOUNDED compile family (PR 4/6/8's
+  fixed-shape discipline);
+- span/trace timestamps ride one monotonic clock (PR 7);
+- sharding specs must agree with the mesh they target (ROADMAP item 1, in
+  the spirit of GSPMD/PartIR: specs are checked, not hand-trusted).
+
+Each of these has already caused a real bug. This package machine-checks
+them in three layers:
+
+- ``static_rules``: a single-pass AST analyzer (pure stdlib — no jax
+  import) with repo-specific rules, suppressible only via
+  ``# graftlint: allow[rule] reason=...`` comments whose reasons are
+  audited (``scripts/graftlint.py --audit``);
+- ``spec_check``: a sharding-spec consistency checker that validates every
+  ``PartitionSpec`` in a ``ShardingPlan`` against the declared mesh axes
+  BEFORE anything compiles (wired into ``parallel.zero.make_plan``);
+- ``runtime``: compile-family sanitizers — labeled dispatch sites
+  (``bounded_dispatch(name, max_entries)``) count distinct jit cache
+  signatures and fail tests when a site exceeds its declared bound.
+
+See docs/ANALYSIS.md for the rule catalog and suppression policy.
+"""
+from zero_transformer_tpu.analysis.static_rules import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+    analyze_source,
+    analyze_file,
+    analyze_paths,
+    iter_python_files,
+)
+from zero_transformer_tpu.analysis.runtime import (  # noqa: F401
+    CompileFamilyExceeded,
+    DispatchSite,
+    all_sites,
+    bounded_dispatch,
+    set_strict,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "analyze_source",
+    "analyze_file",
+    "analyze_paths",
+    "iter_python_files",
+    "CompileFamilyExceeded",
+    "DispatchSite",
+    "all_sites",
+    "bounded_dispatch",
+    "set_strict",
+]

@@ -1,0 +1,180 @@
+"""Sharding-spec consistency checker: validate specs against the mesh
+BEFORE anything compiles.
+
+The first machine-checked piece of ROADMAP item 1's spec algebra (GSPMD
+2105.04663 / PartIR 2401.11202: specs are *checked or derived*, never
+hand-trusted). ``parallel.zero.make_plan`` calls ``check_plan`` on every
+plan it builds, so a bad rule table or a hand-edited spec fails at plan
+time with a precise message instead of surfacing deep inside pjit as an
+unrelated sharding error at first dispatch.
+
+Checks, per spec (a ``PartitionSpec`` or the spec of a ``NamedSharding``):
+
+- every axis it names is a declared axis of the mesh;
+- no axis shards two different dims of one tensor (XLA rejects this late
+  and cryptically);
+- with the leaf's shape available: each sharded dim is divisible by the
+  product of its axes' sizes (the ZeRO-axis-on-an-indivisible-dim class —
+  ``sharding._add_zero_axis`` guarantees this by construction, so a
+  violation means a hand-seeded or corrupted plan).
+
+Pure tree walks — no device work, no compilation.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Collection, List, Optional
+
+import jax
+
+
+class SpecError(ValueError):
+    """One or more sharding specs disagree with the mesh. ``errors`` holds
+    every individual message (the exception text joins them)."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = list(errors)
+        super().__init__(
+            f"{len(self.errors)} sharding-spec inconsistencies:\n  "
+            + "\n  ".join(self.errors)
+        )
+
+
+def _spec_of(leaf) -> Optional[tuple]:
+    """PartitionSpec entries of a NamedSharding / PartitionSpec leaf."""
+    spec = getattr(leaf, "spec", None)  # NamedSharding
+    if spec is None and type(leaf).__name__ == "PartitionSpec":
+        spec = leaf
+    if spec is None:
+        return None
+    return tuple(spec)
+
+
+def _axes_of(entry) -> tuple:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def check_entry_spec(
+    spec,
+    mesh,
+    where: str,
+    shape: Optional[tuple] = None,
+    allow_uneven: Collection[str] = (),
+) -> List[str]:
+    """Errors for one spec (optionally against a concrete leaf shape).
+
+    ``allow_uneven``: axes permitted to shard a dim unevenly — GSPMD pads
+    ragged shards, so raggedness is a *component* limitation, not a spec
+    inconsistency, and it arises from honest inputs (an imported 50257
+    vocab over ``tensor=2``, a 3-layer stack over ``pipe=2``; components
+    that cannot pad own their refusal, e.g. the pipeline's "divisible"
+    error in ``make_train_step``). ``make_plan`` keeps ONLY the ZeRO axes
+    strict: ``sharding._add_zero_axis`` skips indivisible dims by
+    construction, so a ragged ZeRO dim means a hand-seeded or corrupted
+    plan."""
+    entries = _spec_of(spec)
+    if entries is None:
+        return []
+    errors: List[str] = []
+    declared = set(mesh.axis_names)
+    seen: dict = {}
+    for dim, entry in enumerate(entries):
+        for axis in _axes_of(entry):
+            if axis not in declared:
+                errors.append(
+                    f"{where}: dim {dim} names axis {axis!r} which is not "
+                    f"a mesh axis (declared: {sorted(declared)})"
+                )
+                continue
+            if axis in seen:
+                errors.append(
+                    f"{where}: axis {axis!r} shards both dim {seen[axis]} "
+                    f"and dim {dim} — an axis may shard at most one dim"
+                )
+            seen[axis] = dim
+    if shape is not None:
+        if len(entries) > len(shape):
+            errors.append(
+                f"{where}: spec has {len(entries)} entries for a rank-"
+                f"{len(shape)} leaf"
+            )
+        for dim, entry in enumerate(entries[: len(shape)]):
+            axes = [a for a in _axes_of(entry) if a in declared]
+            # a dim is exempt only when EVERY axis on it is allowed-uneven;
+            # mixing in one strict (ZeRO) axis re-arms the check for the
+            # full world — _add_zero_axis only ever adds the ZeRO axis when
+            # the whole product divides, so raggedness on a mixed dim still
+            # means a hand-seeded or corrupted spec
+            if not axes or all(a in allow_uneven for a in axes):
+                continue
+            world = math.prod(int(mesh.shape[a]) for a in axes)
+            if world > 1 and shape[dim] % world:
+                errors.append(
+                    f"{where}: dim {dim} of size {shape[dim]} is not "
+                    f"divisible by {'x'.join(axes)}={world} — the shard "
+                    "would be ragged (the ZeRO-axis-on-indivisible-dim "
+                    "class; sharding._add_zero_axis skips such dims, so "
+                    "this spec was hand-seeded or corrupted)"
+                )
+    return errors
+
+
+def check_tree(
+    tree: Any,
+    mesh,
+    where: str,
+    shapes: Any = None,
+    allow_uneven: Collection[str] = (),
+) -> List[str]:
+    """Errors for every NamedSharding/PartitionSpec leaf of ``tree``.
+    ``shapes``: matching pytree of shaped leaves (e.g. from eval_shape) to
+    enable divisibility checks."""
+    errors: List[str] = []
+    is_spec = lambda x: _spec_of(x) is not None  # noqa: E731
+    leaves = jax.tree_util.tree_leaves_with_path(tree, is_leaf=is_spec)
+    shape_leaves = None
+    if shapes is not None:
+        shape_leaves = jax.tree_util.tree_leaves(shapes)
+        if len(shape_leaves) != len(leaves):
+            shape_leaves = None  # structure mismatch: shape checks off
+    for i, (path, leaf) in enumerate(leaves):
+        if _spec_of(leaf) is None:
+            continue
+        shape = None
+        if shape_leaves is not None:
+            shape = tuple(getattr(shape_leaves[i], "shape", ()) or ())
+            shape = shape or None
+        errors += check_entry_spec(
+            leaf,
+            mesh,
+            f"{where}{jax.tree_util.keystr(path)}",
+            shape=shape,
+            allow_uneven=allow_uneven,
+        )
+    return errors
+
+
+def check_plan(
+    plan, mesh, abstract_state: Any = None, allow_uneven: Collection[str] = ()
+) -> None:
+    """Validate a ``parallel.zero.ShardingPlan`` against ``mesh``; raises
+    ``SpecError`` listing every inconsistency. ``abstract_state``: matching
+    abstract TrainState (eval_shape output) to enable divisibility checks
+    on the state specs. ``allow_uneven``: see ``check_entry_spec``."""
+    errors: List[str] = []
+    errors += check_tree(
+        plan.state, mesh, "state", shapes=abstract_state,
+        allow_uneven=allow_uneven,
+    )
+    errors += check_tree(
+        plan.zero,
+        mesh,
+        "zero",
+        shapes=getattr(abstract_state, "params", None),
+        allow_uneven=allow_uneven,
+    )
+    errors += check_tree(plan.batch, mesh, "batch")
+    if errors:
+        raise SpecError(errors)

@@ -1,0 +1,932 @@
+"""Single-pass AST rules enforcing the repo's hard-won invariants.
+
+Pure stdlib (``ast`` + ``tokenize``) — importable and runnable without jax,
+so the lint lane costs seconds, not a backend init. Loaded directly by file
+path from ``scripts/graftlint.py`` to keep even the package ``__init__``
+chain (which pulls flax/jax) out of the lint process.
+
+Rules (catalog + rationale in docs/ANALYSIS.md):
+
+- ``donation-safety``: values that flow from ``jax.device_put`` / orbax
+  restores into a DONATED argument position — or out of a function as a
+  return value callers may donate — without passing through
+  ``jax_compat.ensure_donatable``. On jax 0.4.37 CPU a donated zero-copy
+  host view lets XLA recycle memory it never owned (glibc heap corruption;
+  PR 2's bug class, re-fixed in PR 5).
+- ``host-sync-in-hot-path``: ``.item()``, ``jax.device_get``,
+  ``block_until_ready``, ``np.asarray`` of device values, and
+  ``float()/int()/bool()`` of device values inside functions marked
+  ``# graftlint: hot-path`` (engine tick, train loop, span append).
+- ``wall-clock-in-span-path``: ``time.time()`` anywhere in scanned code —
+  span/trace timestamps must ride ONE monotonic clock; genuinely-wall-clock
+  uses carry an audited suppression.
+- ``broad-except-in-supervised-seam``: bare / ``Exception`` /
+  ``BaseException`` handlers inside functions marked
+  ``# graftlint: supervised-seam`` that neither re-raise nor hand the
+  exception to a fault classifier — they would swallow the supervisor's
+  retryable-vs-fatal classification.
+- ``lock-held-device-sync``: blocking device ops (the host-sync set) inside
+  any ``with ...lock...:`` body — a device sync under the engine lock
+  stalls every submit/scrape for the sync's duration.
+- ``sharding-spec``: ``PartitionSpec``/``P`` literals naming axes that are
+  not declared mesh axes, or repeating an axis within one spec (the static
+  half of ``analysis.spec_check``).
+
+Suppression: ``# graftlint: allow[rule] reason=...`` on the offending line
+or the line directly above. A missing/empty reason is itself a finding
+(``suppression-missing-reason``), as is an allow that matched nothing
+(``unused-suppression``) — the audit trail stays honest.
+
+Markers:
+
+- ``# graftlint: hot-path`` on/above a ``def``: the function (and its
+  nested functions) is a no-host-sync region;
+- ``# graftlint: supervised-seam`` on/above a ``def``: broad excepts inside
+  must classify, not swallow;
+- ``# graftlint: donates[i,j,...]`` on an assignment or ``def``: declares
+  the bound callable as donating those positional argument indices (for
+  jitted callables whose ``donate_argnums`` the analyzer cannot see through
+  an indirection).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+ALL_RULES = (
+    "donation-safety",
+    "host-sync-in-hot-path",
+    "wall-clock-in-span-path",
+    "broad-except-in-supervised-seam",
+    "lock-held-device-sync",
+    "sharding-spec",
+)
+# meta-rules guard the audit trail itself and are NOT suppressible
+META_RULES = ("suppression-missing-reason", "unused-suppression", "parse-error")
+
+# declared mesh axes (parallel/mesh.py is the source of truth; the CLI
+# re-derives this set from its AST so a renamed axis cannot silently stale
+# the linter — see refresh_mesh_axes)
+MESH_AXES: Set[str] = {"data", "fsdp", "expert", "tensor", "sequence", "pipe"}
+
+# taint sources: calls whose result may be a zero-copy host view the XLA
+# runtime does not own (device_put from host numpy; orbax/msgpack restores).
+# checkpoint.CheckpointManager.restore/restore_verified/restore_params are
+# NOT here: they seal through ensure_donatable at the source (pinned by
+# tests/test_graftlint.py::test_checkpoint_restores_are_sealed) — raw orbax
+# ``.restore`` calls remain tainted.
+_TAINT_LAST = {
+    "device_put",
+    "restore",
+    "partial_restore",
+    "import_params_msgpack",
+    "from_bytes",
+}
+# calls that launder taint: the result is a freshly allocated runtime-owned
+# buffer whatever went in
+_CLEANER_LAST = {"ensure_donatable"}
+
+# known donating entry points that per-module analysis cannot see through
+# (jitted elsewhere / behind an attribute swap): last path segment ->
+# donated positional indices. Extend in-source with # graftlint: donates[..]
+KNOWN_DONATING: Dict[str, Tuple[int, ...]] = {
+    "train_step": (0, 3),
+    "step_fn": (0, 3),
+    "prefill": (3,),
+}
+
+_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+_DIRECTIVE_RE = re.compile(r"graftlint:\s*(.*)$")
+_ALLOW_RE = re.compile(r"allow\[([^\]]*)\]\s*(?:reason=(.*))?$")
+_DONATES_RE = re.compile(r"donates\[([^\]]*)\]")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class _Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+def _dotted(node: ast.AST) -> str:
+    """Dotted source name of a Name/Attribute chain ('' when dynamic)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of a Name/Attribute/Subscript/Call chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call, ast.Starred)):
+        node = (
+            node.func
+            if isinstance(node, ast.Call)
+            else getattr(node, "value", None)
+        )
+        if node is None:
+            return None
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _Module:
+    """One parsed file: AST + comments resolved into suppressions/markers."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.suppressions: Dict[int, _Suppression] = {}
+        self.hot_lines: Set[int] = set()
+        self.seam_lines: Set[int] = set()
+        self.donates_lines: Dict[int, Tuple[int, ...]] = {}
+        self.meta_findings: List[Finding] = []
+        self._scan_comments()
+        self.hot_funcs = self._mark_funcs(self.hot_lines)
+        self.seam_funcs = self._mark_funcs(self.seam_lines)
+        self.donating = dict(KNOWN_DONATING)
+        self._collect_donating()
+
+    # -- comments ----------------------------------------------------------
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.src).readline)
+            comments = [
+                (t.start[0], t.string) for t in tokens if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            comments = []
+        for line, text in comments:
+            m = _DIRECTIVE_RE.search(text)
+            if not m:
+                continue
+            body = m.group(1).strip()
+            if body == "hot-path":
+                self.hot_lines.add(line)
+            elif body == "supervised-seam":
+                self.seam_lines.add(line)
+            elif body.startswith("donates["):
+                dm = _DONATES_RE.match(body)
+                if dm:
+                    try:
+                        idx = tuple(
+                            int(p) for p in dm.group(1).split(",") if p.strip()
+                        )
+                    except ValueError:
+                        idx = ()
+                    self.donates_lines[line] = idx
+            elif body.startswith("allow["):
+                am = _ALLOW_RE.match(body)
+                if am is None:
+                    continue
+                rules = tuple(
+                    r.strip() for r in am.group(1).split(",") if r.strip()
+                )
+                reason = (am.group(2) or "").strip()
+                self.suppressions[line] = _Suppression(line, rules, reason)
+                if not reason:
+                    self.meta_findings.append(
+                        Finding(
+                            "suppression-missing-reason",
+                            self.path,
+                            line,
+                            0,
+                            f"allow[{','.join(rules)}] without a reason= — "
+                            "every suppression must say WHY the invariant "
+                            "does not apply here",
+                        )
+                    )
+
+    def _mark_funcs(self, lines: Set[int]) -> List[ast.AST]:
+        """Resolve marker comment lines to the function defs they annotate:
+        the marker sits on the ``def`` line itself or up to 2 lines above
+        (decorators included)."""
+        out = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            first = min(
+                [node.lineno] + [d.lineno for d in node.decorator_list]
+            )
+            if any(ln in lines for ln in range(first - 2, node.lineno + 1)):
+                out.append(node)
+        return out
+
+    def _collect_donating(self) -> None:
+        """Find donating callables: jit/pjit calls with a literal
+        ``donate_argnums`` bound to a name, defs decorated with one, and
+        explicit ``# graftlint: donates[...]`` markers."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = _last(_dotted(node.targets[0]))
+                if not target:
+                    continue
+                idx = self._donate_argnums(node.value)
+                if idx is None:
+                    idx = self._marker_for(node.lineno)
+                if idx:
+                    self.donating[target] = idx
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                idx: Optional[Tuple[int, ...]] = None
+                for dec in node.decorator_list:
+                    idx = idx or self._donate_argnums(dec)
+                first = min(
+                    [node.lineno] + [d.lineno for d in node.decorator_list]
+                )
+                if idx is None:
+                    for ln in range(first - 2, node.lineno + 1):
+                        if ln in self.donates_lines:
+                            idx = self.donates_lines[ln]
+                            break
+                if idx:
+                    self.donating[node.name] = idx
+
+    def _marker_for(self, lineno: int) -> Optional[Tuple[int, ...]]:
+        for ln in (lineno, lineno - 1):
+            if ln in self.donates_lines:
+                return self.donates_lines[ln]
+        return None
+
+    @staticmethod
+    def _donate_argnums(node: ast.AST) -> Optional[Tuple[int, ...]]:
+        """Literal donate_argnums of a jit/pjit/partial(jit, ...) call."""
+        if not isinstance(node, ast.Call):
+            return None
+        name = _last(_dotted(node.func))
+        if name == "partial":
+            inner = node.args[0] if node.args else None
+            if inner is None or _last(_dotted(inner)) not in ("jit", "pjit"):
+                return None
+        elif name not in ("jit", "pjit"):
+            return None
+        for kw in node.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                try:
+                    val = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    return ()
+                if isinstance(val, int):
+                    return (val,)
+                if isinstance(val, (tuple, list)):
+                    return tuple(v for v in val if isinstance(v, int))
+                return ()
+        return None
+
+    # -- suppression application ------------------------------------------
+
+    def suppress(self, finding: Finding) -> Finding:
+        for ln in (finding.line, finding.line - 1):
+            sup = self.suppressions.get(ln)
+            if sup and finding.rule in sup.rules and sup.reason:
+                sup.used = True
+                finding.suppressed = True
+                finding.reason = sup.reason
+                return finding
+        return finding
+
+
+# ---------------------------------------------------------------------------
+# scope helpers
+
+
+def _functions(tree: ast.AST) -> List[ast.AST]:
+    return [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def _in_any(node_lines: Tuple[int, int], funcs: Iterable[ast.AST]) -> bool:
+    lo, hi = node_lines
+    for f in funcs:
+        if f.lineno <= lo and (f.end_lineno or f.lineno) >= hi:
+            return True
+    return False
+
+
+def _host_names(func: ast.AST) -> Set[str]:
+    """Names bound (anywhere in ``func``) to values that are host-side by
+    construction: ``jax.device_get`` results (tuple unpacks included),
+    ``.tolist()``, numpy constructors, literals, ``len``/``sorted``/...
+    Order-insensitive — good enough for flag/no-flag decisions."""
+    host: Set[str] = set()
+    HOST_CALLS = {
+        "device_get",
+        "tolist",
+        "len",
+        "sorted",
+        "list",
+        "dict",
+        "range",
+        "int",
+        "float",
+        "bool",
+        "str",
+        "min",
+        "max",
+        "sum",
+        "enumerate",
+        "zip",
+        "monotonic",
+        "now",
+        "time",
+        "perf_counter",
+    }
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        is_host = False
+        if isinstance(val, ast.Call):
+            name = _dotted(val.func)
+            last = _last(name)
+            is_host = last in HOST_CALLS or name.startswith(("np.", "numpy."))
+        elif isinstance(val, ast.Constant):
+            is_host = True
+        if not is_host:
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                host.add(target.id)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for el in target.elts:
+                    if isinstance(el, ast.Name):
+                        host.add(el.id)
+    return host
+
+
+def _sync_calls(
+    body: Iterable[ast.AST], host: Set[str]
+) -> List[Tuple[ast.Call, str]]:
+    """Device-synchronizing calls in ``body``: (node, description)."""
+    out: List[Tuple[ast.Call, str]] = []
+    for node in body:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _dotted(sub.func)
+            last = _last(name)
+            if last == "item" and isinstance(sub.func, ast.Attribute):
+                out.append((sub, ".item() forces a device->host sync"))
+            elif last == "block_until_ready":
+                out.append((sub, "block_until_ready() blocks on the device"))
+            elif name in ("jax.device_get", "device_get"):
+                out.append((sub, "jax.device_get forces a device->host sync"))
+            elif name in _SYNC_NP and sub.args:
+                root = _root_name(sub.args[0])
+                if root is None or root not in host:
+                    out.append(
+                        (sub, f"{name}() of a possibly-device value copies "
+                              "through host")
+                    )
+            elif (
+                isinstance(sub.func, ast.Name)
+                and sub.func.id in ("float", "int", "bool")
+                and len(sub.args) == 1
+                and isinstance(sub.args[0], (ast.Subscript, ast.Attribute))
+            ):
+                root = _root_name(sub.args[0])
+                if root is not None and root not in host and root != "self":
+                    out.append(
+                        (sub, f"{sub.func.id}() of {_dotted(sub.args[0]) or root}"
+                              " syncs if it holds a device array")
+                    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+
+def _rule_wall_clock(mod: _Module) -> List[Finding]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) == "time.time":
+            out.append(
+                Finding(
+                    "wall-clock-in-span-path",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    "time.time() is not monotonic — span/trace timestamps "
+                    "must use time.monotonic() (suppress only for genuinely "
+                    "wall-clock metadata)",
+                )
+            )
+    return out
+
+
+def _rule_host_sync(mod: _Module) -> List[Finding]:
+    out = []
+    for func in mod.hot_funcs:
+        host = _host_names(func)
+        for call, why in _sync_calls([func], host):
+            out.append(
+                Finding(
+                    "host-sync-in-hot-path",
+                    mod.path,
+                    call.lineno,
+                    call.col_offset,
+                    f"{why} inside hot path {func.name!r} — hot loops must "
+                    "not host-sync (keep the one designed sync point, "
+                    "suppressed with a reason)",
+                )
+            )
+    return out
+
+
+def _rule_lock_sync(mod: _Module) -> List[Finding]:
+    out = []
+    funcs = _functions(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lockish = any(
+            "lock" in _dotted(item.context_expr).lower()
+            or (
+                isinstance(item.context_expr, ast.Call)
+                and "lock" in _dotted(item.context_expr.func).lower()
+            )
+            for item in node.items
+        )
+        if not lockish:
+            continue
+        # host-name context of the smallest enclosing function
+        enclosing = [
+            f
+            for f in funcs
+            if f.lineno <= node.lineno
+            and (f.end_lineno or f.lineno) >= (node.end_lineno or node.lineno)
+        ]
+        host = (
+            _host_names(min(enclosing, key=lambda f: (f.end_lineno or 0) - f.lineno))
+            if enclosing
+            else set()
+        )
+        for call, why in _sync_calls(node.body, host):
+            out.append(
+                Finding(
+                    "lock-held-device-sync",
+                    mod.path,
+                    call.lineno,
+                    call.col_offset,
+                    f"{why} while holding a lock — device syncs under the "
+                    "engine lock stall every submit/scrape for their "
+                    "duration",
+                )
+            )
+    return out
+
+
+def _rule_broad_except(mod: _Module) -> List[Finding]:
+    CLASSIFIERS = re.compile(
+        r"(classify|fault|escalate|_abort|_fail|_finish|retryable)", re.I
+    )
+    out = []
+    for func in mod.seam_funcs:
+        for node in ast.walk(func):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or _last(_dotted(node.type)) in (
+                "Exception",
+                "BaseException",
+            )
+            if not broad:
+                continue
+            handled = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Raise):
+                    handled = True
+                    break
+                if isinstance(sub, ast.Call) and CLASSIFIERS.search(
+                    _dotted(sub.func)
+                ):
+                    handled = True
+                    break
+            if not handled:
+                out.append(
+                    Finding(
+                        "broad-except-in-supervised-seam",
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"broad except in supervised seam {func.name!r} "
+                        "neither re-raises nor classifies — it would swallow "
+                        "the supervisor's retryable-vs-fatal decision",
+                    )
+                )
+    return out
+
+
+def _local_mesh_axes(mod: _Module) -> Set[str]:
+    """Axis names a module declares on its OWN ``Mesh(...)`` constructions
+    (probe/test meshes, e.g. pod_check's 1-D ``("all",)`` mesh) — legal for
+    that module's specs in addition to the repo's declared axes."""
+    axes: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call) and _last(_dotted(node.func)) == "Mesh"
+        ):
+            continue
+        candidates = list(node.args) + [
+            kw.value for kw in node.keywords if kw.arg == "axis_names"
+        ]
+        for arg in candidates:
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                for el in arg.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str
+                    ):
+                        axes.add(el.value)
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                axes.add(arg.value)
+    return axes
+
+
+def _rule_sharding_spec(mod: _Module, axes: Set[str]) -> List[Finding]:
+    out = []
+    axes = set(axes) | _local_mesh_axes(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last(_dotted(node.func)) not in ("P", "PartitionSpec"):
+            continue
+        seen: Dict[str, int] = {}
+        literals: List[Tuple[str, ast.AST]] = []
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                literals.append((arg.value, arg))
+            elif isinstance(arg, (ast.Tuple, ast.List)):
+                for el in arg.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str
+                    ):
+                        literals.append((el.value, el))
+        for name, where in literals:
+            if name not in axes:
+                out.append(
+                    Finding(
+                        "sharding-spec",
+                        mod.path,
+                        where.lineno,
+                        where.col_offset,
+                        f"PartitionSpec names axis {name!r} which is not a "
+                        f"declared mesh axis {sorted(axes)}",
+                    )
+                )
+            count = seen.get(name, 0) + 1
+            seen[name] = count
+            if count == 2:
+                out.append(
+                    Finding(
+                        "sharding-spec",
+                        mod.path,
+                        where.lineno,
+                        where.col_offset,
+                        f"PartitionSpec uses axis {name!r} twice — an axis "
+                        "may shard at most one dim of a tensor",
+                    )
+                )
+    return out
+
+
+class _TaintScope:
+    """Per-function donation-safety walk (statement order respected)."""
+
+    def __init__(self, mod: _Module, func: ast.AST, findings: List[Finding]):
+        self.mod = mod
+        self.func = func
+        self.findings = findings
+        self.tainted: Set[str] = set()
+        # nested defs whose returns are tainted: their NAME becomes a taint
+        # source in the enclosing scope (the encloser may still apply the
+        # ensure_donatable seam around e.g. a tree_map over the callback)
+        self.tainted_funcs: Set[str] = set()
+        self._nesting = 0
+
+    # -- expression classification ----------------------------------------
+
+    def _expr_taints(self, node: ast.AST) -> bool:
+        """Does evaluating ``node`` produce a possibly-runtime-unowned
+        buffer? Cleaner calls launder everything beneath them."""
+        if isinstance(node, ast.Call):
+            name = _last(_dotted(node.func))
+            if name in _CLEANER_LAST:
+                return False
+            if name in ("float", "int", "bool", "str", "len", "repr"):
+                return False  # host scalars carry no buffer to donate
+            if name in _TAINT_LAST:
+                return True
+            # a call propagates taint from its arguments (tree.map etc.)
+            return any(
+                self._expr_taints(a)
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+            )
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted or node.id in self.tainted_funcs
+        if isinstance(node, ast.Attribute):
+            return _dotted(node) in self.tainted
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_taints(e) for e in node.elts)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._expr_taints(node.value)
+        if isinstance(node, ast.IfExp):
+            return self._expr_taints(node.body) or self._expr_taints(node.orelse)
+        if isinstance(node, ast.Lambda):
+            return self._expr_taints(node.body)
+        return False
+
+    # -- statement walk (source order: taint/clean must sequence) ----------
+
+    def run(self) -> None:
+        self._stmts(self.func.body)
+
+    def _scan_calls(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+
+    def _stmts(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: same taint scope (closures see outer names).
+                # Its tainted returns don't fire directly — they mark the
+                # function NAME tainted, and findings arise where the
+                # encloser lets the product escape unsealed.
+                outer, self.func = self.func, stmt
+                self._nesting += 1
+                self._stmts(stmt.body)
+                self._nesting -= 1
+                self.func = outer
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                self._scan_calls(stmt.test)
+                self._stmts(stmt.body)
+                self._stmts(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_calls(stmt.iter)
+                self._stmts(stmt.body)
+                self._stmts(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_calls(item.context_expr)
+                self._stmts(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self._stmts(stmt.body)
+                for h in stmt.handlers:
+                    self._stmts(h.body)
+                self._stmts(stmt.orelse)
+                self._stmts(stmt.finalbody)
+            else:
+                self._scan_calls(stmt)
+                if isinstance(stmt, ast.Assign):
+                    self._assign(stmt.targets, stmt.value)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    self._assign([stmt.target], stmt.value)
+                elif isinstance(stmt, ast.AugAssign):
+                    if self._expr_taints(stmt.value):
+                        name = _dotted(stmt.target)
+                        if name:
+                            self.tainted.add(name)
+                elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                    self._return(stmt)
+
+    def _assign(self, targets: List[ast.AST], value: ast.AST) -> None:
+        taints = self._expr_taints(value)
+        for target in targets:
+            names = (
+                [e for e in target.elts]
+                if isinstance(target, (ast.Tuple, ast.List))
+                else [target]
+            )
+            for n in names:
+                name = _dotted(n) if isinstance(n, (ast.Name, ast.Attribute)) else ""
+                if not name:
+                    continue
+                if taints:
+                    self.tainted.add(name)
+                else:
+                    self.tainted.discard(name)
+
+    def _return(self, stmt: ast.Return) -> None:
+        if not self._expr_taints(stmt.value):
+            return
+        if self._nesting > 0:
+            self.tainted_funcs.add(self.func.name)
+            return
+        self.findings.append(
+            Finding(
+                "donation-safety",
+                self.mod.path,
+                stmt.lineno,
+                stmt.col_offset,
+                f"{self.func.name!r} returns buffers that flow from "
+                "device_put/checkpoint restore — a caller that donates "
+                "them corrupts the heap on jax 0.4.37; route through "
+                "jax_compat.ensure_donatable (or suppress with the "
+                "reason the result is never donated)",
+            )
+        )
+
+    def _call(self, call: ast.Call) -> None:
+        name = _dotted(call.func)
+        last = _last(name)
+        args = list(call.args)
+        if last == "_in_mesh" and len(args) >= 2:
+            # _in_mesh(mesh, fn, *real_args): the callee is args[1]
+            last = _last(_dotted(args[1]))
+            args = args[2:]
+        donated = self.mod.donating.get(last)
+        if not donated:
+            return
+        for i in donated:
+            if i < len(args) and self._expr_taints(args[i]):
+                src = _dotted(args[i]) or ast.dump(args[i])[:40]
+                self.findings.append(
+                    Finding(
+                        "donation-safety",
+                        self.mod.path,
+                        call.lineno,
+                        call.col_offset,
+                        f"argument {i} ({src}) of donating call {last!r} "
+                        "flows from device_put/checkpoint restore without "
+                        "an ensure_donatable seam — donated zero-copy host "
+                        "views corrupt the heap on jax 0.4.37",
+                    )
+                )
+
+
+def _rule_donation(mod: _Module) -> List[Finding]:
+    findings: List[Finding] = []
+    funcs = _functions(mod.tree)
+    # nested functions are walked by their own scope only (ast.walk of the
+    # outer function includes the inner one's statements; dedupe by running
+    # outermost scopes and letting name-taint stay function-local)
+    tops = [
+        f
+        for f in funcs
+        if not _in_any(
+            (f.lineno, f.end_lineno or f.lineno),
+            [g for g in funcs if g is not f],
+        )
+    ]
+    for func in tops:
+        _TaintScope(mod, func, findings).run()
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def refresh_mesh_axes(repo_root: Path) -> Set[str]:
+    """Re-derive the declared axis-name set from parallel/mesh.py (AST only
+    — no import): every ``X_AXIS = "name"`` module constant. Falls back to
+    the built-in set when the file is missing/unreadable."""
+    mesh_py = Path(repo_root) / "zero_transformer_tpu" / "parallel" / "mesh.py"
+    try:
+        tree = ast.parse(mesh_py.read_text())
+    except (OSError, SyntaxError):
+        return set(MESH_AXES)
+    axes = set()
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.endswith("_AXIS")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            axes.add(node.value.value)
+    return axes or set(MESH_AXES)
+
+
+def analyze_source(
+    src: str,
+    path: str = "<string>",
+    rules: Optional[Sequence[str]] = None,
+    mesh_axes: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Run the selected rules over one source string. Suppressions applied;
+    meta-findings (bad/unused suppressions) appended unsuppressed."""
+    want = set(rules or ALL_RULES)
+    try:
+        mod = _Module(path, src)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                "parse-error", path, exc.lineno or 0, exc.offset or 0, str(exc)
+            )
+        ]
+    findings: List[Finding] = []
+    if "wall-clock-in-span-path" in want:
+        findings += _rule_wall_clock(mod)
+    if "host-sync-in-hot-path" in want:
+        findings += _rule_host_sync(mod)
+    if "lock-held-device-sync" in want:
+        findings += _rule_lock_sync(mod)
+    if "broad-except-in-supervised-seam" in want:
+        findings += _rule_broad_except(mod)
+    if "sharding-spec" in want:
+        findings += _rule_sharding_spec(mod, mesh_axes or MESH_AXES)
+    if "donation-safety" in want:
+        findings += _rule_donation(mod)
+    findings = [mod.suppress(f) for f in findings]
+    findings += mod.meta_findings
+    for sup in mod.suppressions.values():
+        # only judge a suppression against rules that actually RAN: a
+        # single-rule invocation must not call other rules' allows stale
+        known = [r for r in sup.rules if r in ALL_RULES and r in want]
+        unknown = [r for r in sup.rules if r not in ALL_RULES]
+        for r in unknown:
+            findings.append(
+                Finding(
+                    "unused-suppression",
+                    path,
+                    sup.line,
+                    0,
+                    f"allow[{r}]: unknown rule name (known: "
+                    f"{', '.join(ALL_RULES)})",
+                )
+            )
+        if known and sup.reason and not sup.used:
+            findings.append(
+                Finding(
+                    "unused-suppression",
+                    path,
+                    sup.line,
+                    0,
+                    f"allow[{','.join(known)}] matched no finding — remove "
+                    "the stale suppression (the invariant it excused is "
+                    "gone or moved)",
+                )
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_file(
+    path, rules=None, mesh_axes: Optional[Set[str]] = None
+) -> List[Finding]:
+    p = Path(path)
+    return analyze_source(
+        p.read_text(), str(p), rules=rules, mesh_axes=mesh_axes
+    )
+
+
+def iter_python_files(paths: Sequence) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out += sorted(
+                f
+                for f in p.rglob("*.py")
+                if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def analyze_paths(
+    paths: Sequence,
+    rules=None,
+    mesh_axes: Optional[Set[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings += analyze_file(f, rules=rules, mesh_axes=mesh_axes)
+    return findings

@@ -33,6 +33,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from zero_transformer_tpu.parallel.zero import TrainState
+from zero_transformer_tpu.utils.jax_compat import ensure_donatable
 
 
 from zero_transformer_tpu.utils.paths import is_remote_path  # noqa: F401 (re-export)
@@ -378,7 +379,12 @@ class CheckpointManager:
                 meta=ocp.args.JsonRestore(),
             ),
         )
-        return out["state"], out["meta"]
+        # seal the donation seam AT THE SOURCE: orbax can hand back
+        # zero-copy host views, and consumers (trainer, multihost workers)
+        # feed restored state straight into donating steps — PR 5 re-fixed
+        # exactly this in a consumer that had missed its own seam
+        # graftlint: allow[donation-safety] reason=state element is sealed through ensure_donatable on this line; meta is restored JSON (host dict, no donatable buffers)
+        return ensure_donatable(out["state"]), out["meta"]
 
     # -- trustworthy restore -------------------------------------------------
 
@@ -583,7 +589,10 @@ class CheckpointManager:
                         fallback_steps=report.fallback_steps,
                         quarantined=len(report.quarantined),
                     )
-            return state, meta, report
+            # runtime-owned buffers before ANY consumer can donate them
+            # (the digest ran on the restored values above; add-0 preserves
+            # them bitwise and their shardings)
+            return ensure_donatable(state), meta, report
 
     def restore_params(self, abstract_params: Any, step: Optional[int] = None) -> Any:
         """Params-only restore — the ``warm_init`` path for scale-up surgery
@@ -628,7 +637,7 @@ class CheckpointManager:
             )
         finally:
             ckptr.close()
-        return out["params"]
+        return ensure_donatable(out["params"])
 
     def _step_complete(self, step: int) -> bool:
         """True when ``step``'s directory is a COMMITTED checkpoint.

@@ -83,7 +83,12 @@ def shard_for_inference(model: Transformer, params: Any, mesh) -> Any:
     shardings = shd.param_sharding(
         mesh, shd.unbox(abstract), shd.logical_specs(abstract), zero_stage=0
     )
-    return jax.device_put(shd.unbox(params), shardings)
+    from zero_transformer_tpu.utils.jax_compat import ensure_donatable
+
+    # restored/imported param trees are host numpy; device_put of host
+    # memory can be zero-copy — force runtime ownership once at placement
+    # so no downstream consumer can donate an unowned buffer
+    return ensure_donatable(jax.device_put(shd.unbox(params), shardings))
 
 
 def init_cache(model: Transformer, batch: int, rng=None, mesh=None) -> Any:
@@ -152,7 +157,14 @@ def init_cache(model: Transformer, batch: int, rng=None, mesh=None) -> Any:
             jnp.zeros(s.shape, s.dtype), NamedSharding(mesh, spec)
         )
 
-    return jax.tree_util.tree_map_with_path(place, shapes)
+    from zero_transformer_tpu.utils.jax_compat import ensure_donatable
+
+    # the cache is DONATED by prefill/decode_step/the engine's fused step;
+    # device_put output must be runtime-owned before the first donating
+    # dispatch (jax 0.4.37 zero-copy class — jax_compat.ensure_donatable).
+    # Leaf-by-leaf add-0, so the transient peak is one extra leaf, not 2x
+    # the cache.
+    return ensure_donatable(jax.tree_util.tree_map_with_path(place, shapes))
 
 
 def _in_mesh(mesh, fn, *args, **kwargs):

@@ -78,6 +78,7 @@ class FlightRecorder:
             return None
         doc = {
             "reason": reason,
+            # graftlint: allow[wall-clock-in-span-path] reason=deliberately wall-clock — a post-mortem dump is correlated with external logs by unix time; span timestamps ride clock_now (monotonic) on the next line
             "written_at_unix": time.time(),
             "clock_now": self.clock(),
             "extra": extra or {},

@@ -79,6 +79,7 @@ class ProfileWindow:
         if self._busy:
             raise RuntimeError("a profile capture is already in progress")
         self._busy = True
+        # graftlint: allow[wall-clock-in-span-path] reason=deliberately wall-clock — the capture DIRECTORY name is a human-readable unix stamp; no span math touches it
         stamp = name or f"{self.prefix}_{int(time.time())}"
         path = str(Path(self.directory) / "profiles" / stamp)
         self._pending = (int(ticks), path)

@@ -69,6 +69,7 @@ class Tracer:
 
     # ------------------------------------------------------------- recording
 
+    # graftlint: hot-path
     def add(
         self,
         name: str,

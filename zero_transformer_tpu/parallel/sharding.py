@@ -50,6 +50,36 @@ LOGICAL_RULES: dict[str, Optional[str]] = {
     "layers": PIPE_AXIS,
 }
 
+# every axis name the repo may legally put in a rule table. An axis absent
+# from a given mesh is fine (it drops to replication — small meshes declare
+# a subset), but an axis outside this universe is a typo that would
+# silently replicate a param the author meant to shard.
+KNOWN_AXES: frozenset = frozenset(
+    (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, TENSOR_AXIS, SEQUENCE_AXIS, PIPE_AXIS)
+)
+
+
+def validate_rules(rules: dict) -> None:
+    """Reject rule tables naming axes outside the repo's declared universe.
+
+    ``_tp_axes`` intentionally drops axes the target mesh does not carry
+    (``mesh.shape.get(axis, 1)``), which is correct for a small mesh but
+    turns a typo'd axis name into a silent no-shard. This is the
+    hand-trusted gap ROADMAP item 1 closes: specs are checked, not trusted.
+    """
+    bad = {
+        name: axis
+        for name, axis in rules.items()
+        if axis is not None and axis not in KNOWN_AXES
+    }
+    if bad:
+        raise ValueError(
+            "sharding rule table names unknown mesh axes "
+            f"{sorted(set(bad.values()))} (for logical dims {sorted(bad)}); "
+            f"declared axes are {sorted(KNOWN_AXES)} — a typo here silently "
+            "replicates the param instead of sharding it"
+        )
+
 
 def logical_specs(boxed_params) -> Any:
     """Pytree of PartitionSpec(logical axis names) from nn.Partitioned boxes."""
@@ -130,6 +160,7 @@ def param_sharding(
     Stage 0-2: TP axes only (params replicated over data/fsdp between steps —
     reference behavior, ``main_zero.py:455,500``). Stage 3: + ZeRO axis (FSDP).
     """
+    validate_rules(LOGICAL_RULES if rules is None else rules)
     zaxes = zero_axes(mesh)
 
     def one(leaf, spec):
@@ -147,6 +178,7 @@ def zero_sharding(
     """Fully ZeRO-sharded specs (TP + ZeRO axis) — the layout for optimizer
     state (stage≥1), gradient reduce-scatter targets (stage≥2), and stage-3
     params. Counterpart of reference ``set_partitions_zero`` (``partition.py:90-111``)."""
+    validate_rules(LOGICAL_RULES if rules is None else rules)
     zaxes = zero_axes(mesh)
 
     def one(leaf, spec):

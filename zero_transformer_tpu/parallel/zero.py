@@ -94,12 +94,37 @@ def make_plan(
     state_shardings = TrainState(
         step=NamedSharding(mesh, P()), params=param_specs, opt_state=opt_specs
     )
-    return ShardingPlan(
+    plan = ShardingPlan(
         state=state_shardings,
         batch=shd.batch_sharding(mesh),
         zero=zero_specs,
         logical=logical,
     )
+    # machine-check the plan against the mesh BEFORE anything compiles
+    # (ROADMAP item 1: specs are checked, never hand-trusted) — a bad rule
+    # table or hand-edited spec fails here with a precise message instead
+    # of deep inside pjit at first dispatch. Divisibility is strict ONLY on
+    # the ZeRO axes: _add_zero_axis skips indivisible dims by construction,
+    # so raggedness there means a hand-seeded/corrupted plan. Every other
+    # axis may shard unevenly from honest inputs (an imported 50257 vocab
+    # over tensor=2, a 3-layer stack over pipe=2) — GSPMD pads those, and
+    # components that cannot pad own their refusal (pipeline's "divisible"
+    # error in make_train_step).
+    from zero_transformer_tpu.analysis import spec_check
+
+    abstract_state = TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        params=abstract_params,
+        opt_state=abstract_opt,
+    )
+    strict = set(zero_axes(mesh))
+    spec_check.check_plan(
+        plan,
+        mesh,
+        abstract_state=abstract_state,
+        allow_uneven=tuple(a for a in mesh.axis_names if a not in strict),
+    )
+    return plan
 
 
 def init_train_state(

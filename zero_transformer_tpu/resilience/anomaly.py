@@ -103,7 +103,14 @@ class AnomalyGuard:
             # failed cross-replica agreement checks (audit_frequency > 0)
             "audit_failures": zero(jnp.int32),
         }
-        return jax.device_put(carry, self._replicated)
+        from zero_transformer_tpu.utils.jax_compat import ensure_donatable
+
+        # the guarded step DONATES the carry (wrap(): donate_argnums=(0, 3));
+        # device_put output must be forced runtime-owned before the first
+        # dispatch, same seam as every restore path (graftlint found this
+        # one — the leaves happen to be device-born jnp.zeros today, but
+        # the invariant is about the seam, not today's provenance)
+        return ensure_donatable(jax.device_put(carry, self._replicated))
 
     def _flag(self, loss, grad_norm, carry):
         cfg = self.cfg

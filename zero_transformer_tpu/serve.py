@@ -257,6 +257,7 @@ def _build_generator(args) -> TextGenerator:
         params = quantize_params(params, cfg)
     params = jax.tree.map(jnp.asarray, params)
     tokenizer = _load_tokenizer(args.tokenizer)
+    # graftlint: allow[donation-safety] reason=params are never donated — generate/engine donate cache+logits+masks+rngs by argnum, params excluded; the TP path additionally seals inside shard_for_inference
     return TextGenerator(
         cfg, params, tokenizer, cache_len=args.cache_len,
         speculative=args.speculative, tensor=args.tensor,
@@ -285,6 +286,7 @@ def _reload_loader(gen: "TextGenerator", args):
             return shard_for_inference(gen.model, params, gen.mesh)
         return jax.tree.map(jnp.asarray, params)
 
+    # graftlint: allow[donation-safety] reason=the closure's product is consumed only by engine.reload_params, which applies ensure_donatable before the tick-boundary swap
     return load
 
 

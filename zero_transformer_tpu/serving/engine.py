@@ -100,6 +100,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from zero_transformer_tpu.analysis.runtime import (
+    CompileFamilyExceeded,
+    bounded_dispatch,
+)
 from zero_transformer_tpu.obs import (
     LATENCY_BUCKETS,
     FlightRecorder,
@@ -827,6 +831,17 @@ class ServingEngine:
         self._chunk_fused = _CHUNK_SHARED
         self._paged_chunk = _PAGED_CHUNK_SHARED
         self._spec = _SPEC_SHARED
+        # compile-family sanitizer (analysis/runtime.py): each labeled jit
+        # dispatch site declares the number of distinct cache signatures it
+        # may legitimately produce over this engine's lifetime. The fixed-
+        # shape discipline says ONE each — the fused decode step, the
+        # [S, C] chunk prefill, and the K-draft verify are all single
+        # programs whatever the occupancy/prompt mix. A second signature
+        # means some per-request axis leaked into a shape or static
+        # (strict mode raises listing the signatures; production warns).
+        self._ds_decode = bounded_dispatch("engine.decode_step", 1)
+        self._ds_prefill = bounded_dispatch("engine.prefill_chunk", 1)
+        self._ds_spec = bounded_dispatch("engine.spec_verify", 1)
         # distinct one-shot prefill bucket lengths this engine has compiled
         # (legacy path); bounded by max_prefill_buckets + the capacity bucket
         self._buckets_seen: set = set()
@@ -1369,6 +1384,8 @@ class ServingEngine:
 
     # ------------------------------------------------------- chunked prefill
 
+    # graftlint: hot-path
+    # graftlint: supervised-seam
     def _prefill_tick(self) -> bool:
         """Process ONE chunk for every mid-prefill slot in a single
         fixed-shape [n_slots, chunk] dispatch, then install the slots whose
@@ -1432,9 +1449,7 @@ class ServingEngine:
             if self._chaos is not None:
                 self._chaos.on_prefill_chunk(self._tick)
             if paged:
-                cache, last = _in_mesh(
-                    self.mesh,
-                    self._paged_chunk,
+                chunk_args = (
                     self.model,
                     self.params,
                     self.slots.cache,
@@ -1445,10 +1460,12 @@ class ServingEngine:
                     jnp.asarray(self.slots.table),
                     jnp.asarray(self._index_after(starts, lens, active), jnp.int32),
                 )
+                # observe skips model+params (engine-lifetime constants):
+                # the describe walk stays O(per-tick args), not O(params)
+                self._ds_prefill.observe(*chunk_args[2:])
+                cache, last = _in_mesh(self.mesh, self._paged_chunk, *chunk_args)
             else:
-                cache, last = _in_mesh(
-                    self.mesh,
-                    self._chunk_fused,
+                chunk_args = (
                     self.model,
                     self.slots.axes_items,
                     self.params,
@@ -1458,6 +1475,14 @@ class ServingEngine:
                     jnp.asarray(lens, jnp.int32),
                     jnp.asarray(active, jnp.bool_),
                 )
+                # skip model (0) + params (2); axes_items are cache statics
+                self._ds_prefill.observe(chunk_args[1], *chunk_args[3:])
+                cache, last = _in_mesh(self.mesh, self._chunk_fused, *chunk_args)
+        except CompileFamilyExceeded:
+            # strict-mode sanitizer trip: the whole point is the readable
+            # signature listing — it must reach the test harness, not be
+            # classified as a prefill fault and fed to the breaker
+            raise
         except Exception as exc:
             self._on_prefill_fault(exc)
             return True
@@ -1662,6 +1687,8 @@ class ServingEngine:
                     kept.append(cand)
             self._queue = kept
 
+    # graftlint: hot-path
+    # graftlint: supervised-seam
     def step(self) -> bool:
         """One scheduler tick: swap-in reload, sweep, admit, chunk-prefill
         budget (one chunk per mid-prefill slot, batched), supervised fused
@@ -1717,9 +1744,7 @@ class ServingEngine:
             if self.draft_k:
                 blocks, n_emits, bad_rows = self._dispatch_spec()
             else:
-                token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, bad = _in_mesh(
-                    self.mesh,
-                    self._fused,
+                fused_args = (
                     self.model,
                     self.sampling,
                     self.params,
@@ -1727,6 +1752,12 @@ class ServingEngine:
                     self.slots.cache,
                     self._gen_mask,
                     self._rngs,
+                )
+                # skip model (0) + params (2) — engine-lifetime constants;
+                # sampling statics + cache/logits/mask/rng shapes remain
+                self._ds_decode.observe(fused_args[1], *fused_args[3:])
+                token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, bad = _in_mesh(
+                    self.mesh, self._fused, *fused_args
                 )
                 if self._chaos is not None:
                     # injected NaNs land AFTER the step, so re-run the same
@@ -1737,9 +1768,15 @@ class ServingEngine:
                         self._tick, self._last_logits
                     )
                     bad = _in_mesh(self.mesh, nonfinite_rows, self._last_logits)
+                # graftlint: allow[host-sync-in-hot-path] reason=THE designed per-tick sync — one coalesced device_get of token + poison mask (PR 2's one-sync budget); every other read rides it
                 tokens, bad_rows = jax.device_get((token, bad))
                 blocks = [[int(t)] for t in tokens.tolist()]
                 n_emits = [1] * self.n_slots
+        except CompileFamilyExceeded:
+            # strict-mode sanitizer trip: surface the signature listing to
+            # the test harness instead of feeding it to the breaker as an
+            # opaque tick fault (non-strict mode never raises — it warns)
+            raise
         except Exception as exc:
             # ring entry FIRST: a breaker trip inside _on_tick_fault dumps
             # the recorder, and the dump must contain the tick that tripped
@@ -1857,6 +1894,7 @@ class ServingEngine:
 
     # --------------------------------------------------- speculative decode
 
+    # graftlint: hot-path
     def _dispatch_spec(self):
         """Run the speculative fused step for this tick: host-propose K
         draft tokens per decoding slot (prompt-lookup over the slot's own
@@ -1877,9 +1915,7 @@ class ServingEngine:
             # clamp a misbehaving custom draft_fn: wrong-length or
             # out-of-vocab drafts must degrade acceptance, not crash a tick
             drafts[slot] = [t % V for t in d[:K]] + [0] * (K - len(d))
-        x, n_acc, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, self._veto, bad = _in_mesh(
-            self.mesh,
-            self._spec,
+        spec_args = (
             self.model,
             self.sampling,
             K,
@@ -1892,11 +1928,17 @@ class ServingEngine:
             self._veto,
             jnp.asarray(active, jnp.bool_),
         )
+        # skip model (0) + params (3) — engine-lifetime constants
+        self._ds_spec.observe(*spec_args[1:3], *spec_args[4:])
+        x, n_acc, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, self._veto, bad = _in_mesh(
+            self.mesh, self._spec, *spec_args
+        )
         if self._chaos is not None:
             self._last_logits = self._chaos.poison_logits(
                 self._tick, self._last_logits
             )
             bad = bad | _in_mesh(self.mesh, nonfinite_rows, self._last_logits)
+        # graftlint: allow[host-sync-in-hot-path] reason=THE designed per-tick sync of the speculative path — one coalesced device_get of the accepted block + counts + poison mask
         xs, n_accs, bad_rows = jax.device_get((x, n_acc, bad))
         self.stats["spec_ticks"] += 1
         blocks = [row.tolist() for row in xs]
@@ -2340,6 +2382,13 @@ class ServingEngine:
                 else 0.0
             ),
         }
+        # compile-family sanitizer gauges: distinct jit signatures seen per
+        # labeled dispatch site vs its declared bound; a nonzero violation
+        # count is the "serving got slow" compile-storm smoking gun
+        for site in (self._ds_decode, self._ds_prefill, self._ds_spec):
+            short = site.name.rsplit(".", 1)[-1]
+            snap[f"dispatch_{short}_signatures"] = site.distinct
+            snap[f"dispatch_{short}_violations"] = site.violations
         if self._prefix_cache is not None:
             snap.update(self._prefix_cache.stats())
         else:

@@ -435,6 +435,14 @@ class Trainer:
             cfg.training.train_context,
         )
         self.state: Optional[TrainState] = None
+        # compile-family sanitizer (analysis/runtime.py): the train step is
+        # ONE program for the whole run — batch geometry, rng layout and
+        # carry structure are fixed at build time. A second distinct
+        # signature here means a shape leaked into the step loop (the
+        # "training got slow" recompile class; strict mode raises in tests)
+        from zero_transformer_tpu.analysis.runtime import bounded_dispatch
+
+        self.dispatch_site = bounded_dispatch("trainer.step", 1)
 
     @property
     def val_loader(self) -> DataLoader:
@@ -499,10 +507,9 @@ class Trainer:
                 on_event=self._restore_event,
             )
             self._restore_report = report
-            # restored buffers may be zero-copy views the runtime does not
-            # own; the train step donates this state, so force ownership
-            # before it ever reaches a donating jit (utils/jax_compat.py)
-            state = ensure_donatable(state)
+            # donation seam: restore_verified seals its output through
+            # ensure_donatable at the source (checkpoint.py), so the state
+            # is already runtime-owned when the donating train step sees it
             step = int(state.step)
             loader_state = remap_loader_state(
                 meta,
@@ -546,9 +553,8 @@ class Trainer:
                 self.model, self.tx, self.rng, self.mesh, self.sample_shape, self.plan
             )
             if ck.warm_init and ck.warm_init_msgpack:
-                params = ensure_donatable(
-                    self._warm_params_from_msgpack(ck.warm_init_msgpack)
-                )
+                # donation seam sealed inside _warm_params_from_msgpack
+                params = self._warm_params_from_msgpack(ck.warm_init_msgpack)
                 state = TrainState(
                     step=state.step, params=params, opt_state=state.opt_state
                 )
@@ -556,7 +562,8 @@ class Trainer:
             elif ck.warm_init and ck.warm_init_dir:
                 donor = ckpt_lib.CheckpointManager(ck.warm_init_dir, keep=1)
                 abstract = self.abstract_state()
-                params = ensure_donatable(donor.restore_params(abstract.params))
+                # donation seam sealed inside restore_params (checkpoint.py)
+                params = donor.restore_params(abstract.params)
                 state = TrainState(
                     step=state.step, params=params, opt_state=state.opt_state
                 )
@@ -617,12 +624,16 @@ class Trainer:
                     f"warm-init donor {path} has {name} shaped {tuple(d.shape)} "
                     f"but model {self.cfg.model.name!r} expects {tuple(t.shape)}"
                 )
-        return jax.tree.map(
-            lambda leaf, tgt: jax.device_put(
-                jnp.asarray(leaf, tgt.dtype), tgt.sharding
-            ),
-            donor,
-            abstract,
+        # runtime-owned buffers: device_put of host msgpack leaves can be
+        # zero-copy, and this tree flows into the donating train step
+        return ensure_donatable(
+            jax.tree.map(
+                lambda leaf, tgt: jax.device_put(
+                    jnp.asarray(leaf, tgt.dtype), tgt.sharding
+                ),
+                donor,
+                abstract,
+            )
         )
 
     # -- loops --------------------------------------------------------------
@@ -710,10 +721,12 @@ class Trainer:
             return {}
         return {f"data_{k}": float(v) for k, v in counters().items() if v}
 
+    # graftlint: hot-path
     def train(self, max_steps: Optional[int] = None) -> TrainState:
         cfg = self.cfg.training
         res = self.cfg.resilience
         state = self.state if self.state is not None else self.init_state()
+        # graftlint: allow[host-sync-in-hot-path] reason=once at run start before the loop, not per step — the resume step must be known to size the loop
         start = int(state.step)
         end = min(cfg.total_steps, start + max_steps) if max_steps else cfg.total_steps
         timer = monitoring.StepTimer()
@@ -803,9 +816,16 @@ class Trainer:
                 if tr.enabled:
                     tr.add("data_fetch", "train", t_fetch, t_disp,
                            {"step": step + 1})
+                # observe only the axes that can vary mid-run (batch
+                # geometry, rng layout, guard carry) — state shapes are
+                # fixed at build time and threaded through step_fn, and
+                # describing the whole param tree would cost O(params)
+                # per step for no added detection
                 if guard is not None:
+                    self.dispatch_site.observe(batch, self.rng, carry)
                     state, metrics, carry = step_fn(state, batch, self.rng, carry)
                 else:
+                    self.dispatch_site.observe(batch, self.rng)
                     state, metrics = step_fn(state, batch, self.rng)
                 if tr.enabled:
                     # dispatch, not compute: jax returns futures — the
@@ -821,6 +841,7 @@ class Trainer:
                         watchdog.start()
                     watchdog.beat()
                 if profiling and step >= profile_stop:
+                    # graftlint: allow[host-sync-in-hot-path] reason=profile-window close only — the trace must not stop before the steps it captured finish on device; never reached in steady state
                     jax.block_until_ready(metrics["loss"])
                     jax.profiler.stop_trace()
                     profiling = False
@@ -828,6 +849,7 @@ class Trainer:
                 paused = False
                 if step % cfg.log_frequency == 0 or step == end:
                     t_sync = tr.clock()
+                    # graftlint: allow[host-sync-in-hot-path] reason=THE designed log-point sync (every log_frequency steps, not per step) — the device_sync span right below measures exactly this wait
                     loss = float(metrics["loss"])  # device sync point
                     if tr.enabled:
                         # host-blocked time waiting on the device: the gap
@@ -864,6 +886,7 @@ class Trainer:
                     payload = {
                         "loss": loss,
                         "perplexity": float(jnp.exp(jnp.minimum(jnp.float32(loss), 20.0))),
+                        # graftlint: allow[host-sync-in-hot-path] reason=rides the log-point sync paid by loss above — the step's metrics materialized together; no extra device wait
                         "grad_norm": float(metrics["grad_norm"]),
                         "learning_rate": float(metrics.get("learning_rate", 0.0)),
                         "tokens_seen": float(step) * tokens_per_step,
