@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos obs bench bench-watch serve-bench train-bench e2e-watch fmt fmt-check dryrun lint
+.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos obs bench bench-watch serve-bench train-bench kernel-bench e2e-watch fmt fmt-check dryrun lint
 
 # Invariant lint lane (ISSUE 10): graftlint's repo-specific AST rules +
 # the suppression audit over the whole tree. Pure stdlib — no jax import,
@@ -104,7 +104,8 @@ serve-bench:
 	@cp BENCH_serve_capacity.json /tmp/_serve_cap_baseline.json 2>/dev/null || true
 	@cp BENCH_router.json /tmp/_serve_router_baseline.json 2>/dev/null || true
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
-		--spec-k 4 --greedy --max-new-tokens 32 --cache-len 64 --obs-ab
+		--spec-k 4 --greedy --max-new-tokens 32 --cache-len 64 --obs-ab \
+		--fused-tail-ab
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
 		--shared-prefix --cache-len 64 --out BENCH_serve_prefix.json
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --capacity-sweep \
@@ -142,6 +143,19 @@ train-bench:
 	else \
 		echo "train-bench-guard: no committed baseline; skipping"; \
 	fi
+
+# Kernel lane (ISSUE 11): interpret-mode parity for the Pallas kernels on
+# THIS box (flash train fwd+bwd and serving offset/mask shapes pinned
+# few-ulp vs the XLA reference; the paged-attention decode kernel pinned
+# BITWISE vs the gather-to-slab path it replaces, int8 scales included)
+# plus the per-op microbench's CPU half (the parity block child_flash
+# emits off-TPU — timed flash numbers stay TPU-only with honest
+# provenance). docs/KERNELS.md documents the dispatch-gate decision table.
+kernel-bench:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_kernel.py \
+		tests/test_flash_attention.py -q $(PYTEST_ARGS)
+	JAX_PLATFORMS=cpu $(PY) -c "import bench, json; out = bench.child_flash(); \
+		print(json.dumps(out)); assert out['ok'], 'kernel parity failed'"
 
 # Retry the bench ladder until a live on-chip measurement lands, then promote
 # it to BENCH_measured.json (this image's TPU tunnel wedges for hours at a

@@ -374,7 +374,13 @@ def child_flash() -> dict:
     """Flash-vs-XLA attention microbenchmark, fwd+bwd, swept over sequence
     lengths (the kernel exists to make 8k-32k context viable — one 1k
     datapoint says nothing about that regime). Batch shrinks as T grows to
-    hold tokens (B*T) constant, the way a real long-context run would."""
+    hold tokens (B*T) constant, the way a real long-context run would.
+
+    Off-TPU, timed numbers would be meaningless (Pallas interpret mode runs
+    the kernel as jax ops) — so the CPU branch runs the PARITY half of the
+    per-op A/B instead: flash fwd+bwd and the paged decode kernel pinned
+    against the XLA reference in interpret mode, with provenance labels
+    that keep parity evidence and on-chip timings from being conflated."""
     import time
 
     import jax
@@ -386,6 +392,25 @@ def child_flash() -> dict:
     from zero_transformer_tpu.ops.pallas.flash import flash_attention
 
     print(f"devices_ok platform={jax.default_backend()}", file=sys.stderr)
+    if jax.default_backend() != "tpu":
+        # ONE shared parity implementation with train_step_bench's
+        # interpret_parity block (zero_transformer_tpu.ops.pallas.parity):
+        # the two artifacts must never assert different parity contracts
+        from zero_transformer_tpu.ops.pallas.parity import (
+            interpret_parity_report,
+        )
+
+        report = interpret_parity_report()
+        return {
+            "ok": report["ok"],
+            "provenance": "interpret_mode_parity_cpu",
+            "note": (
+                "off-TPU: Pallas interpret-mode PARITY only — timed "
+                "flash-vs-XLA numbers require the chip and are absent by "
+                "design"
+            ),
+            "points": report["cases"],
+        }
     seqs = [int(s) for s in os.environ.get("BENCH_FLASH_SEQS", "1024,4096,8192,16384").split(",")]
     H, D = 12, 128
     tokens = 8 * 1024  # B*T held constant across the sweep

@@ -9,6 +9,8 @@ tolerance:
 
 - ``decode_tok_s`` (aggregate decode throughput) drops > 15%
 - ``itl_ms.p99`` (tail inter-token latency) grows > 15%
+- ``itl_ms_decode_only.p99`` (pure-decode tail — the fused sampling tail /
+  paged-kernel home metric) grows > 15%
 - the fresh artifact's measured span-tracing overhead (``obs_overhead``,
   from the loadgen's --obs-ab tracing-on/off A/B on this same run's
   hardware) exceeds 2% of decode tok/s — observability must stay
@@ -163,6 +165,23 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
         )
     else:
         msgs.append(f"ok: itl_ms.p99 {fresh_p99:.3f} ms (baseline {base_p99:.3f} ms)")
+
+    # decode-only ITL tail (PR 11): the fused sampling tail's home metric —
+    # ticks with no prefill work are pure decode, so a regression here is a
+    # kernel/tail regression, not admission-mix noise
+    base_d99 = (baseline.get("itl_ms_decode_only") or {}).get("p99", 0)
+    fresh_d99 = (fresh.get("itl_ms_decode_only") or {}).get("p99", 0)
+    if base_d99 and fresh_d99 > base_d99 * (1 + tolerance):
+        ok = False
+        msgs.append(
+            f"REGRESSION: itl_ms_decode_only.p99 {fresh_d99:.3f} ms > "
+            f"{(1 + tolerance) * 100:.0f}% of baseline {base_d99:.3f} ms"
+        )
+    elif base_d99:
+        msgs.append(
+            f"ok: itl_ms_decode_only.p99 {fresh_d99:.3f} ms "
+            f"(baseline {base_d99:.3f} ms)"
+        )
 
     obs = fresh.get("obs_overhead")
     if obs and obs.get("overhead_frac", 0) > OBS_OVERHEAD_MAX:

@@ -89,6 +89,16 @@ def parse_args(argv=None):
                    help="greedy sampling: with --spec-k the engine output "
                         "is bit-identical to plain decode, so the parity "
                         "verification stays byte-exact")
+    p.add_argument("--fused-tail-ab", action="store_true",
+                   help="also drive a DEFUSED-tail control engine "
+                        "(sampling as its own dispatch after the forward, "
+                        "fused_tail=False, speculation off) and embed it "
+                        "as no_fused_tail — the fused-vs-split sampling "
+                        "A/B the kernel lane prices")
+    p.add_argument("--no-fused-tail", action="store_true",
+                   help="run the MEASURED engine with the defused tail "
+                        "(A/B control; byte-identical output, disables "
+                        "--spec-k)")
     p.add_argument("--capacity-sweep", action="store_true",
                    help="capacity mode: ramp concurrent streams at mixed "
                         "prompt lengths against a slab engine and a paged "
@@ -219,9 +229,16 @@ def build(args):
     kv_layout = args.kv_layout if args.prefill_chunk else "slab"
 
     def engine(chaos=None, prefix_cache=None, spec_k=None, slots=None,
-               layout=None, pool_tokens=None, trace=True):
+               layout=None, pool_tokens=None, trace=True, fused_tail=None):
         chunks = prefix_cache if prefix_cache is not None else args.prefix_cache
         lay = layout or kv_layout
+        fused = (
+            fused_tail if fused_tail is not None
+            else not getattr(args, "no_fused_tail", False)
+        )
+        draft = args.spec_k if spec_k is None else spec_k
+        if not fused:
+            draft = 0  # the defused control covers the plain decode path
         return ServingEngine(
             cfg, params, n_slots=slots or args.slots, cache_len=cache_len,
             sampling=sampling, max_queue=args.max_queue, chaos=chaos,
@@ -233,7 +250,8 @@ def build(args):
                 (pool_tokens if pool_tokens is not None else args.page_pool_tokens)
                 if lay == "paged" else 0
             ),
-            draft_k=args.spec_k if spec_k is None else spec_k,
+            draft_k=draft,
+            fused_tail=fused,
             trace=trace,
         )
 
@@ -773,6 +791,20 @@ def main(argv=None) -> dict:
         return run_capacity_sweep(args, cfg, cache_len, make_engine)
     requests = make_requests(args, cfg.vocab_size, cache_len)
 
+    if args.spec_k and args.no_fused_tail:
+        # mirror serve.py's loud handling of the same flag combination: the
+        # defused control covers the plain decode path only. Zeroing
+        # args.spec_k HERE (not just in the engine closure) also stops the
+        # spec warmup arms and the no_speculation control, which would
+        # otherwise compare the measured engine against itself
+        print(
+            "serve_loadgen: --no-fused-tail (the fused-tail A/B control) "
+            "covers the plain decode path only; speculation DISABLED for "
+            "this run",
+            file=sys.stderr,
+        )
+        args.spec_k = 0
+
     if args.spec_k and not args.greedy and not args.no_verify:
         # stochastic speculation preserves the DISTRIBUTION (rejection
         # rule), not the per-seed trajectory — byte-parity vs generate()
@@ -797,8 +829,13 @@ def main(argv=None) -> dict:
     # program families get warmed: the spec-OFF control below must not pay
     # the plain step's compile inside ITS measured window
     warm_specs = (args.spec_k, 0) if args.spec_k else (args.spec_k,)
-    for k in warm_specs:
-        warm = make_engine(spec_k=k)
+    warm_arms = [(k, True) for k in warm_specs]
+    if args.fused_tail_ab or args.no_fused_tail:
+        # the defused control's two programs (standalone sample + forward-
+        # only) must be warm before ITS measured window too
+        warm_arms.append((0, False))
+    for k, fused in warm_arms:
+        warm = make_engine(spec_k=k, fused_tail=fused)
         for prompt, seed in requests[: min(len(requests), args.slots + 1)]:
             warm.submit(prompt, max_new_tokens=args.max_new_tokens, seed=seed)
         warm.run_until_idle()
@@ -837,6 +874,27 @@ def main(argv=None) -> dict:
                 3,
             ),
             "itl_ms_p50": round(csnap["itl_ms_p50"], 3),
+        }
+
+    # DEFUSED-tail control for the fused-sampling A/B (same ordering
+    # discipline: runs before the measured engine so both are equally warm
+    # and the delta isolates the extra dispatch + [S]-token round trip of
+    # the split tail). Speculation off in the control — its comparison
+    # partner is no_speculation (the fused plain-decode arm), not the
+    # spec-on headline.
+    no_fused = None
+    if args.fused_tail_ab:
+        control = make_engine(spec_k=0, fused_tail=False)
+        control_handles, control_wall = run_load(control, requests, args)
+        csnap = control.metrics_snapshot()
+        no_fused = {
+            "decode_tok_s": round(
+                sum(len(h.tokens) for h in control_handles if h is not None)
+                / control_wall,
+                3,
+            ),
+            "itl_ms_p50": round(csnap["itl_ms_p50"], 3),
+            "itl_ms_decode_only_p99": round(csnap["itl_decode_ms_p99"], 3),
         }
 
     # tracing-overhead A/B: alternate OFF/ON arms on the same workload and
@@ -945,6 +1003,12 @@ def main(argv=None) -> dict:
         "acceptance_rate": round(snap["acceptance_rate"], 4),
         "spec_ticks": snap["spec_ticks"],
         "no_speculation": no_spec,
+        # fused-sampling-tail evidence (PR 11): is the measured engine's
+        # sampling inside the single decode program, and the defused
+        # control (None unless --fused-tail-ab measured it)
+        "fused_tail": bool(engine.fused_tail),
+        "kernel_paged_attention": bool(snap["kernel_paged_attention"]),
+        "no_fused_tail": no_fused,
         # observability evidence (ISSUE 7): the tracing-cost A/B (None
         # unless --obs-ab measured it) and the Perfetto span artifact every
         # run saves next to the JSON

@@ -77,3 +77,153 @@ def test_bf16_forward_close():
     np.testing.assert_allclose(
         out.astype(jnp.float32), ref.astype(jnp.float32), atol=2e-2, rtol=2e-2
     )
+
+
+# -------------------------------------------------- serving shapes (PR 11)
+
+
+def _serving_case(B, C, L, H, KVH, D, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (B, C, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, L, KVH, D), dtype)
+    v = jax.random.normal(ks[2], (B, L, KVH, D), dtype)
+    offs = jax.random.randint(ks[3], (B,), 0, L - C + 1, jnp.int32)
+    seg = (jnp.arange(L)[None, :] < (offs[:, None] + C)).astype(jnp.int32)
+    return q, k, v, offs, seg
+
+
+@pytest.mark.parametrize("alibi,B,C,L,H,KVH,D", [
+    (True, 3, 8, 48, 4, 2, 64),    # GQA + ALiBi, chunked-prefill window
+    (False, 2, 16, 64, 6, 6, 64),  # MHA, non-pow2 heads, causal only
+])
+def test_serving_per_row_offsets_and_validity(alibi, B, C, L, H, KVH, D):
+    """The engine's cache shapes: every row's query window at its OWN
+    offset (vector cache index) with a kv-validity mask — the calls the
+    gate used to decline, now pinned few-ulp against the XLA path."""
+    from zero_transformer_tpu.ops.pallas.flash import flash_serving
+
+    q, k, v, offs, seg = _serving_case(B, C, L, H, KVH, D)
+    ref = xla_attention(q, k, v, causal=True, alibi=alibi, q_offset=offs,
+                        segment_ids=seg)
+    out = flash_serving(q, k, v, causal=True, alibi=alibi, q_offset=offs,
+                        segment_ids=seg, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=3e-6, rtol=3e-6)
+
+
+def test_serving_scalar_traced_offset():
+    from zero_transformer_tpu.ops.pallas.flash import flash_serving
+
+    q, k, v, _, _ = _serving_case(2, 8, 48, 4, 4, 64, seed=3)
+    off = jnp.int32(5)
+    seg = jnp.broadcast_to(
+        (jnp.arange(48)[None, :] < off + 8).astype(jnp.int32), (2, 48)
+    )
+    ref = xla_attention(q, k, v, causal=True, alibi=True, q_offset=off,
+                        segment_ids=seg)
+    out = flash_serving(q, k, v, causal=True, alibi=True, q_offset=off,
+                        segment_ids=seg, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=3e-6, rtol=3e-6)
+
+
+def test_serving_rope_rotated_inputs():
+    """RoPE rides OUTSIDE the kernel (the model rotates q/k before the
+    call); the kernel must stay exact on rotated inputs at per-row
+    positions — the serving RoPE-decode shape."""
+    from zero_transformer_tpu.ops.pallas.flash import flash_serving
+    from zero_transformer_tpu.ops.positions import apply_rope
+
+    B, C, L, H, D = 2, 8, 48, 4, 64
+    q, k, v, offs, seg = _serving_case(B, C, L, H, H, D, seed=5)
+    pos_q = offs[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    q = apply_rope(q, pos_q, 10000.0)
+    k = apply_rope(k, jnp.arange(L, dtype=jnp.int32), 10000.0)
+    ref = xla_attention(q, k, v, causal=True, alibi=False, q_offset=offs,
+                        segment_ids=seg)
+    out = flash_serving(q, k, v, causal=True, alibi=False, q_offset=offs,
+                        segment_ids=seg, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=3e-6, rtol=3e-6)
+
+
+# ------------------------------------------------------- gate honesty (PR 11)
+
+
+def test_gate_and_wrapper_signatures_match():
+    """The small-fix contract: every kwarg ``supported`` inspects, the
+    wrapper accepts and THREADS — the gate may never advertise a
+    distinction (alibi, q_offset, segment_ids, doc_ids) it then drops."""
+    import inspect
+
+    from zero_transformer_tpu.ops import flash_attention as fa
+
+    gate = set(inspect.signature(fa.supported).parameters) - {"q", "k", "v"}
+    wrapper = set(inspect.signature(fa.flash_attention).parameters) - {
+        "q", "k", "v"
+    }
+    assert gate == wrapper, (gate, wrapper)
+
+
+def test_gate_alibi_is_threaded(monkeypatch):
+    """alibi=True through the DISPATCHING wrapper must change the output
+    (the pre-fix gate accepted the kwarg and the wrapper dropped no
+    distinction — pin that it stays that way through the serving path
+    too)."""
+    from zero_transformer_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("ZT_PALLAS_INTERPRET", "1")
+    q, k, v = _make_qkv(1, 128, 4, 4, 64)
+    assert fa.supported(q, k, v, causal=True, alibi=True)
+    on = fa.flash_attention(q, k, v, causal=True, alibi=True)
+    off = fa.flash_attention(q, k, v, causal=True, alibi=False)
+    assert not np.allclose(np.asarray(on), np.asarray(off))
+    # serving path threads it too
+    q2, k2, v2, offs, seg = _serving_case(2, 8, 48, 4, 4, 64)
+    on = fa.flash_attention(q2, k2, v2, causal=True, alibi=True,
+                            q_offset=offs, segment_ids=seg)
+    off = fa.flash_attention(q2, k2, v2, causal=True, alibi=False,
+                             q_offset=offs, segment_ids=seg)
+    assert not np.allclose(np.asarray(on), np.asarray(off))
+
+
+def test_forced_flash_decodes_without_raising(monkeypatch):
+    """attention_impl='flash' must not crash the decode loop: flash-or-raise
+    guards the O(T^2) training shapes, but the cache branch's T=1 fallback
+    is an O(S) read that is XLA/paged by design — the model downgrades
+    'flash' to 'auto' there (regression: PR 11 review finding)."""
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.inference.generate import decode_model, generate
+    from zero_transformer_tpu.inference.sampling import SamplingConfig
+
+    monkeypatch.setenv("ZT_PALLAS_INTERPRET", "1")
+    cfg = model_config(
+        "test", dropout=0.0, compute_dtype="float32", attention_impl="flash"
+    )
+    model = decode_model(cfg, 32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    out = generate(
+        model, params, jnp.asarray([[1, 5, 9, 2, 7, 3, 4, 8]], jnp.int32), 4,
+        jax.random.PRNGKey(1), SamplingConfig(greedy=True),
+    )
+    assert out.shape == (1, 4)
+
+
+def test_gate_serving_decisions(monkeypatch):
+    from zero_transformer_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("ZT_PALLAS_INTERPRET", "1")
+    q, k, v, offs, seg = _serving_case(2, 8, 48, 4, 2, 64)
+    # serving shapes now accepted (traced vector offset + validity mask)
+    assert fa.supported(q, k, v, causal=True, q_offset=offs, segment_ids=seg)
+    # single-token decode stays declined: the paged kernel owns it
+    q1 = q[:, :1]
+    assert not fa.supported(q1, k, v, causal=False, q_offset=offs,
+                            segment_ids=seg)
+    # packed-doc masks never combine with cache shapes
+    assert not fa.supported(
+        q, k, v, causal=True, q_offset=offs, segment_ids=seg,
+        doc_ids=jnp.zeros((2, 8), jnp.int32),
+    )
+    # off-TPU without interpret mode: decline everything
+    monkeypatch.delenv("ZT_PALLAS_INTERPRET")
+    if jax.default_backend() != "tpu":
+        assert not fa.supported(q, k, v, causal=True, q_offset=offs,
+                                segment_ids=seg)

@@ -1,0 +1,263 @@
+"""Paged-attention decode kernel parity (ops/pallas/paged_attention.py).
+
+The kernel's contract is BIT-exactness against the gather-to-slab reference
+it replaces: per (row, kv-head) it runs the exact op sequence of
+``jnp.take(pool, table)`` + ``ops.attention.xla_attention``'s per-row
+branch, so swapping the read path can never change a served token. These
+tests pin that bit-for-bit across page sizes {8, 64}, ragged block tables,
+trash-page rows, int8 KV scales, chunk-boundary offsets, and the
+spec-verify window — then prove the ENGINE integration: a serving run with
+the kernels enabled (interpret mode on this CPU image) emits byte-identical
+streams to the gather engine, under strict-mode dispatch sanitizers at one
+compile signature per site.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.ops.attention import xla_attention
+from zero_transformer_tpu.ops.pallas import paged_attention as pa
+
+CACHE_LEN = 48
+
+
+def _case(B, T, H, KVH, D, page, n_blocks, dtype, alibi, int8=False, seed=0,
+          offsets=None, table=None):
+    """Build (q, pools, table, offsets) and both attention paths."""
+    n_pages = B * n_blocks + 4
+    S = page * n_blocks
+    ks = jax.random.split(jax.random.PRNGKey(seed), 8)
+    q = jax.random.normal(ks[0], (B, T, H, D), dtype)
+    if int8:
+        k_pool = jax.random.randint(
+            ks[1], (n_pages, page, KVH, D), -127, 128, jnp.int32
+        ).astype(jnp.int8)
+        v_pool = jax.random.randint(
+            ks[2], (n_pages, page, KVH, D), -127, 128, jnp.int32
+        ).astype(jnp.int8)
+        k_sc = jax.random.uniform(ks[5], (n_pages, page, KVH, 1), jnp.float32, 1e-3, 2e-2)
+        v_sc = jax.random.uniform(ks[6], (n_pages, page, KVH, 1), jnp.float32, 1e-3, 2e-2)
+    else:
+        k_pool = jax.random.normal(ks[1], (n_pages, page, KVH, D), dtype)
+        v_pool = jax.random.normal(ks[2], (n_pages, page, KVH, D), dtype)
+        k_sc = v_sc = None
+    if table is None:
+        table = jax.random.randint(ks[3], (B, n_blocks), 1, n_pages, jnp.int32)
+    if offsets is None:
+        offsets = jax.random.randint(ks[4], (B,), 0, S - T + 1, jnp.int32)
+    offsets = jnp.asarray(offsets, jnp.int32)
+    table = jnp.asarray(table, jnp.int32)
+
+    def reference(q, kp, vp, tbl, off):
+        """The gather-to-slab path the kernel replaces, verbatim."""
+        if int8:
+            g = (jnp.take(kp, tbl, axis=0).astype(jnp.float32)
+                 * jnp.take(k_sc, tbl, axis=0)).astype(dtype).reshape(B, S, KVH, D)
+            gv = (jnp.take(vp, tbl, axis=0).astype(jnp.float32)
+                  * jnp.take(v_sc, tbl, axis=0)).astype(dtype).reshape(B, S, KVH, D)
+        else:
+            g = jnp.take(kp, tbl, axis=0).reshape(B, S, KVH, D)
+            gv = jnp.take(vp, tbl, axis=0).reshape(B, S, KVH, D)
+        kv_valid = (jnp.arange(S)[None, :] < (off[:, None] + T)).astype(jnp.int32)
+        return xla_attention(
+            q, g, gv, causal=T > 1, alibi=alibi, q_offset=off,
+            segment_ids=kv_valid,
+        )
+
+    ref = jax.jit(reference)(q, k_pool, v_pool, table, offsets)
+    out = jax.jit(
+        lambda q, kp, vp, tbl, off: pa.paged_attention(
+            q, kp, vp, tbl, off, causal=T > 1, alibi=alibi,
+            k_scale=k_sc, v_scale=v_sc, interpret=True,
+        )
+    )(q, k_pool, v_pool, table, offsets)
+    return np.asarray(ref), np.asarray(out)
+
+
+@pytest.mark.parametrize("page,n_blocks", [(8, 6), (64, 2)])
+@pytest.mark.parametrize("alibi", [True, False])
+def test_bitwise_vs_gather_page_sizes(page, n_blocks, alibi):
+    ref, out = _case(3, 1, 4, 2, 64, page, n_blocks, jnp.float32, alibi)
+    assert np.array_equal(ref, out)
+
+
+def test_bitwise_bf16_and_gqa():
+    ref, out = _case(2, 1, 8, 2, 64, 8, 4, jnp.bfloat16, True)
+    assert np.array_equal(ref, out)
+
+
+def test_bitwise_mha_single_token():
+    """MHA (G=1) single-token decode — the shape that exposed the per-head
+    2-D-dot lowering divergence: XLA routes an M=1 gemv differently from
+    the reference's batched einsum, so the kernel must keep the kv-head
+    axis INSIDE the contraction. Pinned so a grid refactor can't silently
+    reintroduce the per-head dot."""
+    ref, out = _case(2, 1, 4, 4, 64, 16, 4, jnp.float32, True, seed=11)
+    assert np.array_equal(ref, out)
+
+
+def test_bitwise_spec_verify_window_causal():
+    """T = 1 + draft_k: the spec-verify block attends causally within its
+    window at each row's own offset."""
+    ref, out = _case(2, 5, 4, 4, 64, 8, 4, jnp.float32, False)
+    assert np.array_equal(ref, out)
+    ref, out = _case(2, 4, 6, 6, 64, 8, 3, jnp.float32, True)
+    assert np.array_equal(ref, out)
+
+
+def test_bitwise_int8_kv_scales():
+    """int8 pages dequantize in-register exactly like the gathered view:
+    (int8 -> f32) * scale -> compute dtype, elementwise."""
+    ref, out = _case(2, 1, 4, 2, 64, 8, 4, jnp.float32, True, int8=True)
+    assert np.array_equal(ref, out)
+    ref, out = _case(2, 3, 4, 2, 64, 8, 4, jnp.float32, True, int8=True, seed=7)
+    assert np.array_equal(ref, out)
+
+
+def test_bitwise_ragged_tables_and_trash_rows():
+    """Rows at wildly different fills — including a fully-parked row whose
+    zeroed table routes every read to the trash page — and offsets landing
+    exactly ON and one-before page boundaries (the chunk-boundary cases)."""
+    page, n_blocks = 8, 6
+    B = 5
+    # offsets: 0 (empty-ish), page-1, page (boundary), mid, full-1
+    offsets = [0, page - 1, page, 3 * page + 5, page * n_blocks - 1]
+    table = np.random.default_rng(0).integers(1, B * n_blocks + 3, (B, n_blocks))
+    table[0, :] = 0  # parked row: trash page everywhere
+    ref, out = _case(
+        B, 1, 4, 2, 64, page, n_blocks, jnp.float32, True,
+        offsets=offsets, table=table,
+    )
+    assert np.array_equal(ref, out)
+
+
+def test_gate_decisions():
+    """The ONE gate both the model trace and the engine gauge consult."""
+    common = dict(T=1, D=64, page_size=16, dtype=jnp.float32)
+    assert pa.supported("auto", interpret=True, **common)
+    assert pa.supported("flash", interpret=True, **common)
+    assert not pa.supported("xla", interpret=True, **common)
+    # decode windows only
+    assert not pa.supported(
+        "auto", interpret=True, T=pa.MAX_DECODE_T + 1, D=64, page_size=16,
+        dtype=jnp.float32,
+    )
+    # off-TPU without interpret: decline (the gather path is the fallback)
+    if jax.default_backend() != "tpu":
+        assert not pa.supported("auto", **common)
+    # f16 never
+    assert not pa.supported(
+        "auto", interpret=True, T=1, D=64, page_size=16, dtype=jnp.float16
+    )
+
+
+# ---------------------------------------------------------------- engine e2e
+
+
+def test_engine_kernel_parity_and_one_signature(monkeypatch):
+    """Serving run with the Pallas kernels enabled (interpret mode): every
+    stream byte-identical to the gather-path engine, decode AND spec-verify
+    dispatch sites at ONE compile signature under strict-mode sanitizers,
+    and the paged-kernel gauge honest about what traced."""
+    from zero_transformer_tpu.analysis import runtime as rt
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.inference.sampling import SamplingConfig
+    from zero_transformer_tpu.models import Transformer
+    from zero_transformer_tpu.serving import ServingEngine
+
+    cfg = model_config("test", dropout=0.0, compute_dtype="float32")
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompts = [
+        [(3 + i + j) % 250 + 1 for j in range(n)]
+        for i, n in enumerate((2, 7, 17))
+    ]
+
+    def run(greedy, draft_k):
+        sampling = SamplingConfig(greedy=True) if greedy else SamplingConfig(
+            temperature=0.9, top_k=20
+        )
+        engine = ServingEngine(
+            cfg, params, n_slots=2, cache_len=CACHE_LEN, sampling=sampling,
+            prefill_chunk=8, kv_layout="paged", page_size=8, draft_k=draft_k,
+        )
+        handles = [
+            engine.submit(p, max_new_tokens=8, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        engine.run_until_idle()
+        assert all(h.status == "done" for h in handles)
+        return [h.tokens for h in handles], engine
+
+    monkeypatch.delenv("ZT_PALLAS_INTERPRET", raising=False)
+    gather_plain, _ = run(greedy=False, draft_k=0)
+    gather_spec, _ = run(greedy=True, draft_k=3)
+
+    monkeypatch.setenv("ZT_PALLAS_INTERPRET", "1")
+    rt.set_strict(True)
+    try:
+        kernel_plain, e1 = run(greedy=False, draft_k=0)
+        kernel_spec, e2 = run(greedy=True, draft_k=3)
+    finally:
+        rt.set_strict(None)
+    assert kernel_plain == gather_plain
+    assert kernel_spec == gather_spec
+    for engine in (e1, e2):
+        snap = engine.metrics_snapshot()
+        assert snap["kernel_paged_attention"] == 1
+        assert snap["dispatch_paged_attention_signatures"] == 1
+        assert snap["dispatch_paged_attention_violations"] == 0
+        assert snap["dispatch_decode_step_violations"] == 0
+        assert snap["dispatch_spec_verify_violations"] == 0
+
+
+def test_engine_fused_tail_control_parity():
+    """fused_tail=False (the A/B control: sampling as its own dispatch)
+    emits byte-identical trajectories to the fused path, and its sample
+    site stays at one signature."""
+    from zero_transformer_tpu.config import model_config
+    from zero_transformer_tpu.inference.sampling import SamplingConfig
+    from zero_transformer_tpu.models import Transformer
+    from zero_transformer_tpu.serving import ServingEngine
+
+    cfg = model_config("test", dropout=0.0, compute_dtype="float32")
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompts = [[(5 + i + j) % 250 + 1 for j in range(n)]
+               for i, n in enumerate((3, 9, 14))]
+
+    def run(fused):
+        engine = ServingEngine(
+            cfg, params, n_slots=2, cache_len=CACHE_LEN,
+            sampling=SamplingConfig(temperature=0.9, top_k=20),
+            prefill_chunk=8, kv_layout="paged", page_size=8,
+            fused_tail=fused,
+        )
+        handles = [
+            engine.submit(p, max_new_tokens=8, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        engine.run_until_idle()
+        assert all(h.status == "done" for h in handles)
+        return [h.tokens for h in handles], engine
+
+    fused, ef = run(True)
+    control, ec = run(False)
+    assert fused == control
+    assert ef.metrics_snapshot()["fused_tail"] == 1
+    snap = ec.metrics_snapshot()
+    assert snap["fused_tail"] == 0
+    assert snap["dispatch_sample_tail_signatures"] == 1
+    assert snap["dispatch_sample_tail_violations"] == 0
+    # the control rejects speculation: the verify step cannot be defused
+    with pytest.raises(ValueError):
+        ServingEngine(
+            cfg, params, n_slots=2, cache_len=CACHE_LEN,
+            prefill_chunk=8, kv_layout="paged", page_size=8,
+            fused_tail=False, draft_k=2,
+        )

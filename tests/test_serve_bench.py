@@ -34,6 +34,10 @@ REQUIRED_KEYS = {
     "kv_layout", "page_size", "page_faults", "pages_reclaimed",
     "preemptions", "page_pool_util", "cow_copies",
     "draft_k", "acceptance_rate", "spec_ticks", "no_speculation",
+    # kernel-lane evidence (ISSUE 11): fused sampling tail + defused
+    # control, and whether the paged-attention kernel traced into the
+    # decode program on this run's backend
+    "fused_tail", "kernel_paged_attention", "no_fused_tail",
     # observability evidence (ISSUE 7): tracing-cost A/B (populated by
     # --obs-ab, None otherwise) and the Perfetto span artifact every run
     # writes beside the JSON
@@ -107,6 +111,10 @@ def test_loadgen_artifact_schema_and_invariants(tmp_path):
     assert artifact["kv_layout"] == "paged" and artifact["page_size"] > 0
     assert artifact["preemptions"] == 0
     assert artifact["draft_k"] == 0 and artifact["no_speculation"] is None
+    # fused tail is the default; the defused control needs --fused-tail-ab
+    assert artifact["fused_tail"] is True
+    assert artifact["no_fused_tail"] is None
+    assert artifact["kernel_paged_attention"] in (True, False)
     # every run writes a Perfetto-loadable span trace next to the artifact
     assert artifact["obs_overhead"] is None  # --obs-ab not requested here
     assert artifact["obs_spans"] > 0
@@ -135,6 +143,31 @@ def test_loadgen_speculative_run_verified_with_acceptance(tmp_path):
     assert artifact["acceptance_rate"] > 0
     assert artifact["no_speculation"] is not None
     assert artifact["no_speculation"]["decode_tok_s"] > 0
+
+
+@pytest.mark.slow
+def test_loadgen_fused_tail_ab(tmp_path):
+    """--fused-tail-ab: the defused-tail control engine (sampling as its
+    own dispatch) runs the same workload and embeds a no_fused_tail block;
+    every measured trajectory still verifies byte-identical against
+    generate() — the defused control changes dispatch count, never math.
+    Slow lane: the A/B is an extra full load run (+ its defused warmup);
+    tier-1 covers the schema keys (None without the flag) and the engine
+    fused/defused byte-parity in tests/test_paged_kernel.py, and make
+    serve-bench runs the real A/B into the committed BENCH_serve.json."""
+    loadgen = _load()
+    out = tmp_path / "BENCH_serve_ft.json"
+    artifact = loadgen.main([
+        "--requests", "6", "--slots", "2", "--concurrency", "6",
+        "--max-new-tokens", "8", "--cache-len", "48",
+        "--fused-tail-ab", "--out", str(out),
+    ])
+    assert artifact["fused_tail"] is True
+    nf = artifact["no_fused_tail"]
+    assert nf is not None
+    assert nf["decode_tok_s"] > 0
+    assert nf["itl_ms_decode_only_p99"] >= 0
+    assert artifact["verified"] is True and artifact["mismatches"] == 0
 
 
 @pytest.mark.slow
@@ -357,6 +390,15 @@ def test_serve_bench_guard_logic():
     # within tolerance passes
     ok, _ = guard.compare(base, {**base, "decode_tok_s": 540.0,
                                  "itl_ms": {"p99": 2.2}})
+    assert ok
+    # decode-only ITL tail (the fused-tail/kernel home metric) is graded
+    # too, and absent blocks (older baselines) are skipped, not failed
+    both = {**base, "itl_ms_decode_only": {"p99": 1.0}}
+    ok, msgs = guard.compare(both, {**both, "itl_ms_decode_only": {"p99": 1.5}})
+    assert not ok and any("decode_only" in m for m in msgs)
+    ok, _ = guard.compare(both, {**both, "itl_ms_decode_only": {"p99": 1.1}})
+    assert ok
+    ok, _ = guard.compare(base, both)
     assert ok
     # different hardware: a regression-shaped delta SKIPS instead of failing
     other_hw = {**slow, "platform": {"backend": "tpu", "device": "v4"}}

@@ -524,6 +524,12 @@ class ServingConfig:
     page_size: int = 16
     page_pool_tokens: int = 0
     draft_k: int = 0
+    # fused decode tail (PR 11): sampling (temperature/top-k/veto/rejection)
+    # runs INSIDE the single jitted decode/spec-verify program. False is the
+    # A/B CONTROL — sampling as its own dispatch after the forward — kept
+    # only so the bench can price the fusion (BENCH_serve.json's
+    # no_fused_tail arm); byte-identical trajectories either way.
+    fused_tail: bool = True
 
     def __post_init__(self):
         if self.slots < 1:
@@ -574,6 +580,12 @@ class ServingConfig:
             )
         if self.draft_k < 0:
             raise ValueError("serving.draft_k must be >= 0 (0 disables)")
+        if not self.fused_tail and self.draft_k:
+            raise ValueError(
+                "serving.fused_tail=False (the A/B control) covers the "
+                "plain decode path only; speculative verify (draft_k > 0) "
+                "is inseparable from its in-program sampling"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

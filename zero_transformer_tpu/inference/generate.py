@@ -330,6 +330,24 @@ def _stream_sample(sampling, rng, logits, gen_mask):
     return token, gen_mask | newly
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(4, 5, 6))
+def _stream_step(model, sampling, params, token, cache, gen_mask, rng):
+    """Fused per-token stream step: forward the PREVIOUS token through the
+    cache, then process + sample from the fresh logits — attention →
+    logits → sample in ONE dispatch. The pre-kernel-lane stream paid two
+    dispatches per token (a standalone sample jit plus the cached
+    forward); the fused form halves the per-token dispatch count while
+    emitting the IDENTICAL token chain (same rng split order)."""
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
+    )
+    logits = logits[:, -1, :].astype(jnp.float32)
+    rng, sub = jax.random.split(rng)
+    token = sample_token(sub, logits, sampling, gen_mask)
+    newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
+    return token, vars_out["cache"], gen_mask | newly, rng
+
+
 def stream_tokens(
     model: Transformer,
     params: Any,
@@ -350,6 +368,12 @@ def stream_tokens(
     forward is skipped, matching ``generate``); rows that hit
     ``eos_token_id`` stop the stream when ALL rows are done (callers doing
     single-row streaming just break on their own EOS).
+
+    Since the kernel lane (PR 11) each token past the first costs ONE
+    dispatch (``_stream_step``: forward + sample fused); the first token
+    samples from the prefill logits. The final token's forward is still
+    skipped and the rng split chain is unchanged, so the emitted tokens are
+    bit-identical to the pre-fusion stream and to ``generate``.
     """
     # the mesh context is scoped per CALL, never across a yield: a generator
     # suspended inside a `with jax.set_mesh(...)` would leak the ambient mesh
@@ -360,16 +384,19 @@ def stream_tokens(
     )
     B = prompt.shape[0]
     done = jnp.zeros((B,), jnp.bool_)
+    rng, sub = jax.random.split(rng)
+    token, gen_mask = _stream_sample(sampling, sub, logits, gen_mask)
     for step in range(max_new_tokens):
-        rng, sub = jax.random.split(rng)
-        token, gen_mask = _stream_sample(sampling, sub, logits, gen_mask)
         yield token
         if eos_token_id is not None:
             done = done | (token == eos_token_id)
             if bool(jnp.all(done)):
                 return
         if step + 1 < max_new_tokens:  # the last token is never fed back
-            logits, cache = _in_mesh(mesh, prefill, model, params, token[:, None], cache)
+            token, cache, gen_mask, rng = _in_mesh(
+                mesh, _stream_step, model, sampling, params, token, cache,
+                gen_mask, rng,
+            )
 
 
 def generate_tokens(

@@ -33,7 +33,7 @@ from zero_transformer_tpu.parallel.sharding import (
     constrain_activation,
     replicate_activation,
 )
-from zero_transformer_tpu.ops.attention import dot_product_attention, xla_attention
+from zero_transformer_tpu.ops.attention import dot_product_attention
 from zero_transformer_tpu.ops.losses import chunked_next_token_loss, next_token_loss
 from zero_transformer_tpu.ops.positions import apply_rope
 
@@ -209,6 +209,14 @@ class Attention(nn.Module):
         offset = 0
         int8_cache = cfg.kv_cache_dtype == "int8"
         paged = self.decode and self.kv_pages is not None
+        # impl="flash" downgrades to "auto" for the DECODE variant only:
+        # flash-or-raise guards against silently taking the O(T^2) path on
+        # training shapes, but the decode model's fallbacks — the T=1
+        # cache-init trace, single-token slab decode, paged-gate declines —
+        # are O(S) reads that are XLA/paged by design, and raising would
+        # crash cache allocation and every decode tick of a
+        # flash-configured model.
+        impl = "auto" if (self.decode and cfg.attention_impl == "flash") else cfg.attention_impl
         bt = None
         if self.decode:
             max_len = self.cache_len or cfg.max_seq_len
@@ -314,21 +322,11 @@ class Attention(nn.Module):
                 cv.value = write(cv.value, vq)
                 ksc.value = write(ksc.value, k_scale)
                 vsc.value = write(vsc.value, v_scale)
-                # dequant fuses into the attention reads; the cache is a
-                # loop carry of the decode while_loop, so XLA cannot hoist
-                # this out — HBM traffic stays at int8 + one f32 scale per
-                # (token, head) instead of bf16 K/V (paged: the gather moves
-                # int8 bytes + scales, dequant happens on the gathered view)
-                # multiply in f32 (scales are stored f32 for exactly this),
-                # round once at the end
-                k_all = (gather(ck.value).astype(jnp.float32) * gather(ksc.value)).astype(dtype)
-                v_all = (gather(cv.value).astype(jnp.float32) * gather(vsc.value)).astype(dtype)
             else:
                 ck.value = write(ck.value, k)
                 cv.value = write(cv.value, v)
-                k_all, v_all = gather(ck.value), gather(cv.value)
             idx.value = offset + T
-            max_len_b = k_all.shape[1]
+            max_len_b = self.cache_len or cfg.max_seq_len
             if per_slot:
                 kv_valid = (
                     jnp.arange(max_len_b)[None, :] < (offset[:, None] + T)
@@ -347,15 +345,50 @@ class Attention(nn.Module):
             if per_slot:
                 overflow = overflow[:, None, None, None]
             q = jnp.where(overflow, jnp.nan, 1.0).astype(q.dtype) * q
-            out = xla_attention(
-                q,
-                k_all,
-                v_all,
-                causal=T > 1,
-                alibi=cfg.position == "alibi",
-                q_offset=offset,
-                segment_ids=kv_valid,
-            )
+            from zero_transformer_tpu.ops.pallas import paged_attention as pa
+
+            if paged and pa.supported(
+                impl, T=T, D=D,
+                page_size=self.kv_pages[1], dtype=dtype,
+            ):
+                # paged-attention kernel: the block table is walked INSIDE
+                # the kernel grid (page fetch per grid step), so the
+                # gather-pages-to-slab view below never materializes —
+                # bit-exact vs that gather path by construction and by test
+                out = pa.paged_attention(
+                    q, ck.value, cv.value, bt.value, offset,
+                    causal=T > 1,
+                    alibi=cfg.position == "alibi",
+                    k_scale=ksc.value if int8_cache else None,
+                    v_scale=vsc.value if int8_cache else None,
+                )
+            else:
+                if int8_cache:
+                    # dequant fuses into the attention reads; the cache is
+                    # a loop carry of the decode while_loop, so XLA cannot
+                    # hoist this out — HBM traffic stays at int8 + one f32
+                    # scale per (token, head) instead of bf16 K/V (paged:
+                    # the gather moves int8 bytes + scales, dequant happens
+                    # on the gathered view) multiply in f32 (scales are
+                    # stored f32 for exactly this), round once at the end
+                    k_all = (gather(ck.value).astype(jnp.float32) * gather(ksc.value)).astype(dtype)
+                    v_all = (gather(cv.value).astype(jnp.float32) * gather(vsc.value)).astype(dtype)
+                else:
+                    k_all, v_all = gather(ck.value), gather(cv.value)
+                # dispatching entry point: chunked-prefill / spec-verify
+                # windows route to the flash kernel where the gate accepts
+                # them (TPU or interpret mode); single-token decode and CPU
+                # keep the XLA path (impl downgrade above)
+                out = dot_product_attention(
+                    q,
+                    k_all,
+                    v_all,
+                    causal=T > 1,
+                    alibi=cfg.position == "alibi",
+                    q_offset=offset,
+                    segment_ids=kv_valid,
+                    impl=impl,
+                )
         elif self.mesh is not None:
             if cfg.cp_impl == "ulysses":
                 from zero_transformer_tpu.ops.ulysses import ulysses_attention as cp_attn
@@ -367,9 +400,12 @@ class Attention(nn.Module):
                 alibi=cfg.position == "alibi", doc_ids=doc_ids,
             )
         else:
+            # `impl` (not cfg.attention_impl): identical for training
+            # models; for the decode variant this branch is the T=1
+            # cache-init trace, which must not flash-or-raise
             out = dot_product_attention(
                 q, k, v, causal=True, alibi=cfg.position == "alibi",
-                doc_ids=doc_ids, impl=cfg.attention_impl,
+                doc_ids=doc_ids, impl=impl,
             )
 
         out = out.reshape(B, T, H * D)

@@ -117,9 +117,13 @@ def dot_product_attention(
 ) -> jax.Array:
     """Dispatching attention entry point used by the models.
 
-    impl="auto" picks the Pallas flash kernel on TPU for full-sequence causal
-    training shapes — including document-masked packing — and falls back to
-    the XLA path everywhere else (decode, CPU tests, odd shapes).
+    impl="auto" picks the Pallas flash kernel on TPU (or under
+    ``ZT_PALLAS_INTERPRET=1`` interpret mode) for full-sequence causal
+    training shapes — including document-masked packing — AND for the
+    serving cache shapes (chunked prefill / spec-verify windows with a
+    traced or per-row q_offset and a kv-validity segment mask), falling
+    back to the XLA path everywhere else (single-token decode, CPU, odd
+    shapes).
     """
     if impl in ("auto", "flash"):
         from zero_transformer_tpu.ops import flash_attention as fa
@@ -129,7 +133,8 @@ def dot_product_attention(
             segment_ids=segment_ids, doc_ids=doc_ids,
         ):
             return fa.flash_attention(
-                q, k, v, causal=causal, alibi=alibi, doc_ids=doc_ids
+                q, k, v, causal=causal, alibi=alibi, q_offset=q_offset,
+                segment_ids=segment_ids, doc_ids=doc_ids,
             )
         if impl == "flash":
             # flash-or-raise contract: never silently hand an explicit

@@ -46,14 +46,19 @@ DEFAULT_BLOCK_K = 512
 _INIT_M = -1e30  # below any finite score; never produced by real inputs
 
 
-def pick_block(n: int, prefer: int) -> Optional[int]:
-    """Largest block <= prefer (>=128) dividing n, or None if none exists.
+def pick_block(n: int, prefer: int, floor: int = 8) -> Optional[int]:
+    """Largest sublane-aligned block <= prefer dividing n (>= ``floor``,
+    multiple of 8 — the f32 sublane tile), or None if none exists.
 
     Shared by the wrappers and the dispatch gate (``ops.flash_attention``) so
-    "supported" and "will actually run" can never disagree."""
+    "supported" and "will actually run" can never disagree. The floor used
+    to be 128 (MXU-efficiency conservatism); serving shapes — chunked
+    prefill windows of 64, small test caches — are legal Mosaic blocks down
+    to the 8-sublane tile, and the gate applies a dtype-aware floor (16 for
+    bf16) on top."""
     b = min(prefer, n)
-    while b >= 128:
-        if n % b == 0:
+    while b >= floor:
+        if n % b == 0 and b % 8 == 0:
             return b
         b //= 2
     return None
@@ -78,46 +83,60 @@ def _bias_block(slope, q_pos0, k_pos0, block_q: int, block_k: int, alibi, causal
 
 
 def _scores(
-    slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi, causal, docs, i, j
+    slope, offs_ref, b, q_ref, k_ref, qid_ref, kid_ref, seg_ref, scale,
+    alibi, causal, docs, segs, i, j
 ):
     """[block_q, block_k] f32 score block shared by all three kernels.
 
     ``docs`` (static) adds the packed-sequence document mask: positions with
-    different ids (float32-encoded ints, exact ==) cannot attend."""
+    different ids (float32-encoded ints, exact ==) cannot attend. ``segs``
+    (static) adds the serving path's kv validity mask: segment id 0 =
+    padding / not-yet-written cache positions, masked out. Offsets are
+    PER-ROW (``offs_ref`` is [2, B]; ``b`` the batch grid index) so the
+    continuous-batching engine's vector cache index — every slot at its own
+    position — rides the same kernels."""
     q = q_ref[0, 0, :, :]
     k = k_ref[0, 0, :, :]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    q_pos0 = offs_ref[0, 0] + i * q.shape[0]
-    k_pos0 = offs_ref[1, 0] + j * k.shape[0]
+    q_pos0 = offs_ref[0, b] + i * q.shape[0]
+    k_pos0 = offs_ref[1, b] + j * k.shape[0]
     s = s * scale + _bias_block(
         slope, q_pos0, k_pos0, q.shape[0], k.shape[0], alibi, causal
     )
     if docs:
         same = qid_ref[0, 0, :][:, None] == kid_ref[0, 0, :][None, :]
         s = s + jnp.where(same, 0.0, NEG_INF).astype(jnp.float32)
+    if segs:
+        s = s + jnp.where(
+            seg_ref[0, 0, :][None, :] != 0.0, 0.0, NEG_INF
+        ).astype(jnp.float32)
     return s
 
 
-def _run_predicate(offs_ref, i, j, block_q: int, block_k: int, causal: bool):
-    """Does block (i, j) contain any causally-visible entry?"""
+def _run_predicate(offs_ref, b, i, j, block_q: int, block_k: int, causal: bool):
+    """Does block (i, j) contain any causally-visible entry for row b?"""
     if not causal:
         return True
-    first_k = offs_ref[1, 0] + j * block_k
-    last_q = offs_ref[0, 0] + i * block_q + block_q - 1
+    first_k = offs_ref[1, b] + j * block_k
+    last_q = offs_ref[0, b] + i * block_q + block_q - 1
     return first_k <= last_q
 
 
 def _fwd_kernel(
     slope_ref, offs_ref, *args,
-    scale: float, causal: bool, alibi: bool, docs: bool, n_k: int,
+    scale: float, causal: bool, alibi: bool, docs: bool, segs: bool, n_k: int,
 ):
-    # id operands exist ONLY when document masking is on: their per-grid-step
+    # id/segment operands exist ONLY when their masking is on: per-grid-step
     # VMEM copies measurably slow the un-masked path (~2x at T=1024 on v5e)
-    qid_ref, kid_ref = (args[0], args[1]) if docs else (None, None)
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = args[2 if docs else 0:]
-    i, j = pl.program_id(2), pl.program_id(3)
+    rest = list(args)
+    qid_ref, kid_ref = (rest[0], rest[1]) if docs else (None, None)
+    rest = rest[2:] if docs else rest
+    seg_ref = rest[0] if segs else None
+    rest = rest[1:] if segs else rest
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
     block_q, block_k = q_ref.shape[2], k_ref.shape[2]
 
@@ -127,11 +146,11 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
+    @pl.when(_run_predicate(offs_ref, b, i, j, block_q, block_k, causal))
     def _compute():
         s = _scores(
-            slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi,
-            causal, docs, i, j,
+            slope, offs_ref, b, q_ref, k_ref, qid_ref, kid_ref, seg_ref,
+            scale, alibi, causal, docs, segs, i, j,
         )
         v = v_ref[0, 0, :, :]
         m_prev = m_scr[:, :1]
@@ -161,7 +180,7 @@ def _dq_kernel(
     qid_ref, kid_ref = (args[0], args[1]) if docs else (None, None)
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
      dq_scr) = args[2 if docs else 0:]
-    i, j = pl.program_id(2), pl.program_id(3)
+    b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
     block_q, block_k = q_ref.shape[2], k_ref.shape[2]
 
@@ -169,11 +188,11 @@ def _dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
+    @pl.when(_run_predicate(offs_ref, b, i, j, block_q, block_k, causal))
     def _compute():
         s = _scores(
-            slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi,
-            causal, docs, i, j,
+            slope, offs_ref, b, q_ref, k_ref, qid_ref, kid_ref, None,
+            scale, alibi, causal, docs, False, i, j,
         )
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -200,7 +219,7 @@ def _dkv_kernel(
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
      dk_scr, dv_scr) = args[2 if docs else 0:]
     # grid: (B, H, n_k, n_q) — j is the k-block, inner index i walks q-blocks
-    j, i = pl.program_id(2), pl.program_id(3)
+    b, j, i = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     slope = slope_ref[pl.program_id(1), 0]
     block_q, block_k = q_ref.shape[2], k_ref.shape[2]
 
@@ -209,11 +228,11 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_run_predicate(offs_ref, i, j, block_q, block_k, causal))
+    @pl.when(_run_predicate(offs_ref, b, i, j, block_q, block_k, causal))
     def _compute():
         s = _scores(
-            slope, offs_ref, q_ref, k_ref, qid_ref, kid_ref, scale, alibi,
-            causal, docs, i, j,
+            slope, offs_ref, b, q_ref, k_ref, qid_ref, kid_ref, None,
+            scale, alibi, causal, docs, False, i, j,
         )
         q = q_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -242,10 +261,13 @@ def _slopes_arg(n_heads: int, alibi: bool) -> jax.Array:
     return jnp.zeros((n_heads, 1), jnp.float32)
 
 
-def _offsets_arg(q_offset, kv_offset) -> jax.Array:
-    return jnp.stack(
-        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
-    ).reshape(2, 1)
+def _offsets_arg(q_offset, kv_offset, B: int) -> jax.Array:
+    """[2, B] int32 (q row 0, kv row 1): scalars broadcast, [B] vectors pass
+    through — the per-row form the serving engine's vector cache index
+    needs (every slot's query block at its own position)."""
+    qo = jnp.broadcast_to(jnp.asarray(q_offset, jnp.int32).reshape(-1), (B,))
+    ko = jnp.broadcast_to(jnp.asarray(kv_offset, jnp.int32).reshape(-1), (B,))
+    return jnp.stack([qo, ko])
 
 
 def _smem_spec():
@@ -269,16 +291,20 @@ def _ids_args(q_ids, k_ids, B, T, S):
 
 def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
          q_offset=0, kv_offset=0, slopes=None, out_dtype=None,
-         q_ids=None, k_ids=None):
+         q_ids=None, k_ids=None, segment_ids=None):
     # [B, T, H, D] → [B, H, T, D]: Mosaic needs the blocked time axis in the
     # sublane position
     docs = q_ids is not None
+    segs = segment_ids is not None
     q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     B, H, T, D = q.shape
     _, KVH, S, _ = k.shape
     G = H // KVH
     n_q, n_k = T // block_q, S // block_k
     id_args = _ids_args(q_ids, k_ids, B, T, S) if docs else ()
+    seg_args = (
+        (segment_ids.astype(jnp.float32).reshape(B, 1, S),) if segs else ()
+    )
 
     if slopes is None:
         slopes = _slopes_arg(H, alibi)
@@ -287,13 +313,14 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
     qid_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, 0, i))
     kid_spec = pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j))
     id_specs = [qid_spec, kid_spec] if docs else []
+    seg_specs = [kid_spec] if segs else []
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, alibi=alibi, docs=docs,
-            n_k=n_k,
+            segs=segs, n_k=n_k,
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[_smem_spec(), _smem_spec(), *id_specs,
+        in_specs=[_smem_spec(), _smem_spec(), *id_specs, *seg_specs,
                   q_spec, kv_spec, kv_spec],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -309,7 +336,7 @@ def _fwd(q, k, v, causal, alibi, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, D), jnp.float32),  # acc
         ],
         interpret=interpret,
-    )(slopes, _offsets_arg(q_offset, kv_offset), *id_args, q, k, v)
+    )(slopes, _offsets_arg(q_offset, kv_offset, B), *id_args, *seg_args, q, k, v)
     return jnp.swapaxes(o, 1, 2), lse
 
 
@@ -329,7 +356,7 @@ def _bwd(q, k, v, o, lse, do, causal, alibi, scale, block_q, block_k, interpret,
 
     if slopes is None:
         slopes = _slopes_arg(H, alibi)
-    offs = _offsets_arg(q_offset, kv_offset)
+    offs = _offsets_arg(q_offset, kv_offset, B)
     q_spec_iq = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec_iq = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0))
     row_spec_iq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
@@ -471,6 +498,54 @@ def flash_attention(
         q, k, v, ids, slopes, causal, alibi, float(scale), block_q, block_k,
         interpret,
     )
+
+
+# graftlint: hot-path
+def flash_serving(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    alibi: bool = False,
+    q_offset=0,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    slopes: Optional[jax.Array] = None,
+    block: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Forward-only flash attention for the serving cache shapes the
+    differentiable entry point cannot express:
+
+    - ``q_offset`` scalar or PER-ROW ``[B]`` (traced): the query block of
+      row r starts at global position ``q_offset[r]`` — the engine's
+      chunked prefill window / spec-verify block over a vector cache index;
+    - ``segment_ids`` ``[B, S]``: kv validity (0 = not-yet-written cache
+      positions past each row's fill cursor, masked out exactly like the
+      XLA path's pad mask).
+
+    Decode never differentiates, so this skips the custom-VJP plumbing and
+    the lse output. Numerics: same online-softmax kernel as training flash,
+    pinned few-ulp against ``ops.attention.xla_attention`` (tests)."""
+    B, T, H, D = q.shape
+    _, S, KVH, _ = k.shape
+    if H % KVH:
+        raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
+    if segment_ids is not None and tuple(segment_ids.shape) != (B, S):
+        raise ValueError(
+            f"segment_ids must be [B, S] = {(B, S)}, got {segment_ids.shape}"
+        )
+    if slopes is not None:
+        slopes = jax.lax.stop_gradient(slopes).reshape(-1, 1).astype(jnp.float32)
+    block_q, block_k = _resolve_blocks(T, S, block, None, None)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
+    o, _ = _fwd(
+        q, k, v, causal, alibi, float(scale), block_q, block_k, interpret,
+        q_offset=q_offset, kv_offset=0, slopes=slopes,
+        segment_ids=segment_ids,
+    )
+    return o
 
 
 def flash_partial(

@@ -1,0 +1,258 @@
+"""Paged-attention decode kernel: block tables read INSIDE the kernel grid.
+
+The serving engine's paged KV cache (``serving/slots.py``, PR 6) stores K/V
+in a global page pool ``[n_pages, page, KVH, D]`` addressed through per-row
+int32 block tables. Until this kernel, every decode/spec-verify dispatch
+first materialized a gather-to-slab view — ``jnp.take(pool, table)`` builds
+a fresh ``[B, cache_len, KVH, D]`` copy of every live row's K/V per token —
+and then ran the slab attention over it. That gather is pure HBM traffic
+the math never needed: attention only has to *read* each page once.
+
+This kernel walks the block table inside the Pallas grid instead: grid
+``(B, KVH, n_blocks)``, with the page axis resolved per grid step through a
+scalar-prefetched table (``PrefetchScalarGridSpec``) so the BlockSpec index
+map fetches ``pool[table[b, j]]`` directly — the pipelined HBM→VMEM copy IS
+the page walk, and no slab view ever exists. int8 KV pages dequantize
+in-register (per-page scale blocks ride the same index map) on their way
+into the VMEM K/V scratch.
+
+Bit-exactness contract: the kernel computes, per (row, kv-head), the exact
+op sequence of the gather path (``jnp.take`` + ``ops.attention.xla_attention``
+per-row branch) — same dot shapes per contraction, same f32 bias add order,
+same ``jax.nn.softmax`` reduction, same output-dot dtypes — so its output is
+bit-identical to the gather path on the same backend (pinned by
+``tests/test_paged_kernel.py`` across page sizes, ragged tables, trash-page
+rows, and int8 scales). Swapping the read path can therefore never change a
+served token.
+
+VMEM note: the whole row's K/V lands in a ``[cache_len, D]`` scratch pair
+per (row, head) — at D=128 bf16 that is 0.5 MB per 1k cache positions, so
+decode contexts to ~8k fit comfortably; past that, a production variant
+would switch to an online-softmax page walk (and forfeit the bitwise
+contract vs the full-softmax slab path).
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports on CPU builds too; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from zero_transformer_tpu.ops.positions import NEG_INF, alibi_slopes
+
+# decode window ceiling: 1 (plain decode) .. 1 + draft_k (spec verify).
+# Larger query windows belong to the flash kernel's chunked-prefill path.
+MAX_DECODE_T = 8
+
+
+def interpret_requested() -> bool:
+    """True when ``ZT_PALLAS_INTERPRET=1``: run the Pallas kernels in
+    interpret mode off-TPU so their numerics are exercised on this CPU
+    image (tests, bench parity lanes). Read at TRACE time — flip it before
+    building the engine/model, not mid-run."""
+    return os.environ.get("ZT_PALLAS_INTERPRET", "") == "1"
+
+
+def supported(
+    impl: str,
+    *,
+    T: int,
+    D: int,
+    page_size: int,
+    dtype,
+    interpret: bool = False,
+) -> bool:
+    """Gate: does the paged kernel handle this decode dispatch?
+
+    ONE function consulted by both the model's paged read path
+    (``models/gpt.py``) and the engine's dispatch-site bookkeeping, so
+    "supported" and "will actually run" can never disagree. ``impl`` is
+    ``cfg.attention_impl``; ``xla`` always declines (the gather path is the
+    reference), ``auto``/``flash`` accept on TPU or under interpret mode.
+    """
+    if impl not in ("auto", "flash"):
+        return False
+    on_tpu = jax.default_backend() == "tpu"
+    if not (on_tpu or interpret or interpret_requested()):
+        return False
+    if T < 1 or T > MAX_DECODE_T:
+        return False  # decode/spec-verify windows only
+    if on_tpu:
+        # Mosaic lowering constraints — interpret mode (the CPU parity
+        # lane) has no tiling and accepts any structurally valid shape
+        if D % 64 or D > 256:
+            return False  # lane-dim alignment for the MXU
+        if page_size % 8:
+            return False  # sublane-aligned page copies into the K/V scratch
+    if dtype not in (jnp.bfloat16, jnp.float32):
+        return False
+    return True
+
+
+def _kernel(
+    # scalar-prefetch refs
+    table_ref, offs_ref,
+    # operands
+    slope_ref, q_ref, k_ref, v_ref, *args,
+    T: int, H: int, KVH: int, page: int, n_blocks: int, scale: float,
+    causal: bool, alibi: bool, int8: bool,
+):
+    """One row's attention over its paged K/V, ALL heads per grid step.
+
+    Grid (B, n_blocks): step j copies page ``table[b, j]``'s block —
+    already pipelined into VMEM by the index map — into the K/V scratch at
+    its logical position (dequantized when int8); the final step runs the
+    full-softmax attention with the gather path's exact einsum subscripts.
+    Keeping the kv-head axis INSIDE the contraction (a batch dim of the
+    einsum, not a grid dim) is load-bearing for the bitwise contract: XLA
+    lowers a per-head 2-D dot through a different gemm path than the
+    reference's batched einsum, and the two differ by ulps at M=1."""
+    # arg order: remaining inputs (int8 scale blocks), the output ref,
+    # then the scratch buffers
+    G = H // KVH
+    if int8:
+        ks_ref, vs_ref = args[0], args[1]
+        o_scr, k_scr, v_scr = args[2], args[3], args[4]
+    else:
+        o_scr, k_scr, v_scr = args[0], args[1], args[2]
+    b, j = pl.program_id(0), pl.program_id(1)
+    S = n_blocks * page
+
+    kb = k_ref[0]  # [page, KVH, D]
+    vb = v_ref[0]
+    if int8:
+        # exact mirror of the gather path's dequant:
+        # (int8 -> f32) * f32 scale -> compute dtype, elementwise
+        kb = (kb.astype(jnp.float32) * ks_ref[0]).astype(k_scr.dtype)
+        vb = (vb.astype(jnp.float32) * vs_ref[0]).astype(v_scr.dtype)
+    k_scr[pl.ds(j * page, page), :, :] = kb.astype(k_scr.dtype)
+    v_scr[pl.ds(j * page, page), :, :] = vb.astype(v_scr.dtype)
+
+    @pl.when(j == n_blocks - 1)
+    def _compute():
+        off = offs_ref[b]
+        qg = q_ref[0]  # [T, KVH, G, D]
+        # scores einsum with the REFERENCE's subscripts (kvh stays a batch
+        # dim), in f32, THEN the scalar scale multiply — xla_attention's
+        # exact order
+        s = jnp.einsum(
+            "tkgd,skd->kgts", qg, k_scr[:],
+            preferred_element_type=jnp.float32,
+        )
+        s = s * jnp.float32(scale)  # [KVH, G, T, S]
+        q_pos = off + jax.lax.broadcasted_iota(jnp.int32, (T, S), 0)
+        kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, S), 1)
+        if alibi:
+            # xla per-row branch: bias = -slope*dist (+ causal NEG_INF
+            # folded into the SAME bias tensor), ONE add onto the scores
+            dist = jnp.maximum(q_pos - kv_pos, 0).astype(jnp.float32)  # [T, S]
+            sl = jnp.stack(
+                [slope_ref[i, 0] for i in range(H)]
+            ).reshape(KVH, G)
+            bias = -sl[:, :, None, None] * dist[None, None, :, :]
+            if causal:
+                visible = kv_pos <= q_pos
+                bias = bias + jnp.where(visible, 0.0, NEG_INF)[None, None, :, :]
+            s = s + bias
+        elif causal:
+            visible = kv_pos <= q_pos
+            s = s + jnp.where(visible, 0.0, NEG_INF)[None, None, :, :]
+        # validity pad is its own SECOND add, exactly like the xla path's
+        # segment_ids term (order matters for the bitwise contract)
+        valid = kv_pos[:1, :] < off + T  # [1, S]
+        s = s + jnp.where(valid, 0.0, NEG_INF)[None, None, :, :]
+        w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(k_scr.dtype)
+        out = jnp.einsum("kgts,skd->tkgd", w, v_scr[:])
+        o_scr[0] = out.astype(o_scr.dtype)
+
+
+# graftlint: hot-path
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_table: jax.Array,
+    q_offset: jax.Array,
+    *,
+    causal: bool,
+    alibi: bool = False,
+    softmax_scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    slopes: Optional[jax.Array] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention straight off the page pool. q ``[B, T, H, D]``
+    (T = 1 decode, 1+K spec verify; RoPE already applied, overflow rows
+    already NaN-poisoned by the caller); ``k_pool``/``v_pool``
+    ``[n_pages, page, KVH, D]`` (int8 with ``k_scale``/``v_scale``
+    ``[n_pages, page, KVH, 1]`` f32, or the compute dtype); ``block_table``
+    ``[B, n_blocks]`` int32 (zeros = the serving layer's trash page);
+    ``q_offset`` ``[B]`` (or scalar) — row r's query block starts at
+    position ``q_offset[r]``, and positions ``>= q_offset[r] + T`` are
+    masked invalid, the gather path's ``kv_valid``.
+
+    Forward-only (the decode path never differentiates). Output is
+    bit-identical to gather-to-slab + ``xla_attention`` on the same
+    backend — see the module docstring for why that holds by construction.
+    """
+    B, T, H, D = q.shape
+    n_pages, page, KVH, _ = k_pool.shape
+    if H % KVH:
+        raise ValueError(f"query heads {H} not divisible by kv heads {KVH}")
+    G = H // KVH
+    _, n_blocks = block_table.shape
+    S = n_blocks * page
+    int8 = k_pool.dtype == jnp.int8
+    if int8 and (k_scale is None or v_scale is None):
+        raise ValueError("int8 pools need k_scale/v_scale pools")
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D**0.5)
+    dtype = q.dtype
+
+    offs = jnp.broadcast_to(jnp.asarray(q_offset, jnp.int32).reshape(-1), (B,))
+    if slopes is None:
+        slopes = alibi_slopes(H) if alibi else jnp.zeros((H,), jnp.float32)
+    slopes = slopes.reshape(H, 1).astype(jnp.float32)
+    q5 = q.reshape(B, T, KVH, G, D)
+
+    # index maps receive the scalar-prefetch refs (table, offsets) last;
+    # the page axis of every pool operand resolves through the table — the
+    # pipelined block fetch IS the page walk
+    qo_spec = pl.BlockSpec((1, T, KVH, G, D), lambda b, j, tbl, off: (b, 0, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, page, KVH, D), lambda b, j, tbl, off: (tbl[b, j], 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, page, KVH, 1), lambda b, j, tbl, off: (tbl[b, j], 0, 0, 0))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM if pltpu else None)
+    in_specs = [smem, qo_spec, kv_spec, kv_spec]
+    operands = [slopes, q5, k_pool, v_pool]
+    if int8:
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_blocks),
+        in_specs=in_specs,
+        out_specs=qo_spec,
+        scratch_shapes=[
+            pltpu.VMEM((S, KVH, D), dtype),
+            pltpu.VMEM((S, KVH, D), dtype),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, T=T, H=H, KVH=KVH, page=page, n_blocks=n_blocks,
+            scale=float(scale), causal=causal, alibi=alibi, int8=int8,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, KVH, G, D), dtype),
+        interpret=interpret or (jax.default_backend() != "tpu" and interpret_requested()),
+    )(block_table.astype(jnp.int32), offs, *operands)
+    return out.reshape(B, T, H, D)

@@ -237,6 +237,7 @@ def _build_generator(args) -> TextGenerator:
     cfg = model_config(
         args.model, compute_dtype=args.dtype, dropout=0.0,
         kv_cache_dtype=args.kv_cache_dtype, param_quant=args.quantize,
+        attention_impl=args.attention_impl,
     )
     params = import_params_msgpack(args.params)
     if args.quantize != "int8" and _has_quantized_leaves(params):
@@ -333,6 +334,13 @@ def _server(gen: TextGenerator, args) -> None:
             flush=True,
         )
         draft_k = 0
+    if draft_k and args.no_fused_tail:
+        print(
+            "serve: --no-fused-tail (the fused-tail A/B control) covers the "
+            "plain decode path only; speculation DISABLED for this run",
+            flush=True,
+        )
+        draft_k = 0
     engine = ServingEngine(
         gen.cfg,
         gen.params,
@@ -351,6 +359,7 @@ def _server(gen: TextGenerator, args) -> None:
         page_size=args.page_size,
         page_pool_tokens=args.page_pool_tokens,
         draft_k=draft_k,
+        fused_tail=not args.no_fused_tail,
         obs_dir=args.obs_dir or args.metrics_dir,
         trace=not args.no_trace,
     )
@@ -430,6 +439,21 @@ def main(argv=None) -> None:
                         "stored int8 with per-channel scales — halves the "
                         "weight HBM reads decode is bound by, and fits "
                         "8B-class models on one 16 GB chip")
+    p.add_argument("--attention-impl", default="auto",
+                   choices=("auto", "xla", "flash"),
+                   help="attention dispatch: 'auto' (default) runs the "
+                        "Pallas kernels — flash for prefill/verify windows, "
+                        "the paged-attention kernel for block-table decode — "
+                        "wherever the gate accepts (TPU, or interpret mode "
+                        "under ZT_PALLAS_INTERPRET=1), XLA elsewhere; 'xla' "
+                        "forces the reference path; 'flash' is flash-or-"
+                        "raise (never silently O(T^2))")
+    p.add_argument("--no-fused-tail", action="store_true",
+                   help="A/B CONTROL: run sampling as its own dispatch "
+                        "after the forward instead of inside the single "
+                        "jitted decode program (byte-identical output; "
+                        "exists so the bench can price the fused tail — "
+                        "disables --draft-k)")
     p.add_argument("--kv-cache-dtype", default="auto", choices=("auto", "int8"),
                    help="int8 halves KV-cache HBM traffic (doubles servable "
                         "context) at slight quantization cost")
@@ -523,7 +547,7 @@ def main(argv=None) -> None:
                         "trace exports land here (defaults to --metrics-dir; "
                         "unset disables dumps/profiling, not recording)")
     p.add_argument("--no-trace", action="store_true",
-                   help="disable span tracing (the bounded ring costs <2% "
+                   help="disable span tracing (the bounded ring costs <2%% "
                         "decode tok/s — BENCH_serve.json obs_overhead is "
                         "the measured number); /metrics histograms stay on")
     p.add_argument("--metrics-interval", type=int, default=200,

@@ -104,6 +104,7 @@ from zero_transformer_tpu.analysis.runtime import (
     CompileFamilyExceeded,
     bounded_dispatch,
 )
+from zero_transformer_tpu.config import resolve_dtype
 from zero_transformer_tpu.obs import (
     LATENCY_BUCKETS,
     FlightRecorder,
@@ -344,12 +345,14 @@ def _percentiles(values: Sequence[float], qs=(50, 90, 99)) -> Dict[str, float]:
     return out
 
 
-def _fused_step_impl(model, sampling, params, last_logits, cache, gen_mask, rngs):
-    """Sample every slot from its own rng chain, then one fused forward.
-
-    Each row reproduces the single-request loop bit-for-bit: the rng
-    split order and the [1, V] sample shapes match ``generate()`` with
-    B=1, so a slot's trajectory is independent of its neighbors."""
+def _sample_tail_impl(sampling, last_logits, gen_mask, rngs):
+    """The sampling half of the decode tick: sample every slot from its
+    own rng chain. Each row reproduces the single-request loop
+    bit-for-bit: the rng split order and the [1, V] sample shapes match
+    ``generate()`` with B=1, so a slot's trajectory is independent of its
+    neighbors. Jitted STANDALONE only by the fused-tail A/B control
+    (``fused_tail=False``); the production path inlines it into the single
+    fused program below."""
     split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
     rngs, subs = split[:, 0], split[:, 1]
 
@@ -358,23 +361,29 @@ def _fused_step_impl(model, sampling, params, last_logits, cache, gen_mask, rngs
 
     token = jax.vmap(sample_row)(subs, last_logits, gen_mask)  # [S]
     newly = jax.nn.one_hot(token, gen_mask.shape[1], dtype=jnp.bool_)
-    gen_mask = gen_mask | newly
+    return token, gen_mask | newly, rngs
+
+
+def _forward_only_impl(model, params, token, cache):
+    """The forward half of the decode tick: one fused model apply + the
+    per-slot non-finite guard (the training anomaly predicate inlines
+    here) so the healthy path pays one dispatch per tick, not two, and the
+    [S] mask rides the same device_get as the tokens."""
     logits, vars_out = model.apply(
         {"params": params, "cache": cache}, token[:, None], mutable=["cache"]
     )
     new_logits = logits[:, -1, :].astype(jnp.float32)
-    # the per-slot non-finite guard is computed IN the fused program (the
-    # training anomaly predicate inlines here) so the healthy path pays one
-    # dispatch per tick, not two, and the [S] mask rides the same device_get
-    # as the tokens
-    return (
-        token,
-        new_logits,
-        vars_out["cache"],
-        gen_mask,
-        rngs,
-        nonfinite_rows(new_logits),
-    )
+    return new_logits, vars_out["cache"], nonfinite_rows(new_logits)
+
+
+def _fused_step_impl(model, sampling, params, last_logits, cache, gen_mask, rngs):
+    """One decode tick as ONE program: the sampling tail + the fused
+    forward, COMPOSED from the exact halves the defused A/B control jits
+    separately — the fused/defused bit-identity is structural, not a
+    copy-discipline promise."""
+    token, gen_mask, rngs = _sample_tail_impl(sampling, last_logits, gen_mask, rngs)
+    new_logits, cache, bad = _forward_only_impl(model, params, token, cache)
+    return token, new_logits, cache, gen_mask, rngs, bad
 
 
 def _jit_fused_step():
@@ -385,6 +394,16 @@ def _jit_fused_step():
 # benches pre-pay compiles for the measured engine); a breaker rebuild swaps
 # in a PRIVATE _jit_fused_step() so a suspect executable is never reused
 _FUSED_SHARED = _jit_fused_step()
+
+
+def _jit_defused_pair():
+    return (
+        jax.jit(_sample_tail_impl, static_argnums=(0,), donate_argnums=(2, 3)),
+        jax.jit(_forward_only_impl, static_argnums=(0,), donate_argnums=(3,)),
+    )
+
+
+_DEFUSED_SHARED = _jit_defused_pair()
 
 
 def _slice_rows(leaf, ax, offsets, length):
@@ -730,6 +749,7 @@ class ServingEngine:
         page_pool_tokens: int = 0,
         draft_k: int = 0,
         draft_fn: Optional[Callable[[Sequence[int], int], List[int]]] = None,
+        fused_tail: bool = True,
         obs_dir: Optional[str] = None,
         trace: bool = True,
         trace_capacity: int = 8192,
@@ -766,6 +786,18 @@ class ServingEngine:
             )
         self.draft_k = int(draft_k)
         self.draft_fn = draft_fn or ngram_propose
+        # fused_tail=False is the A/B CONTROL: sampling runs as its own
+        # dispatch after the forward (the pre-kernel-lane shape) instead of
+        # inside the single decode program. Byte-identical trajectories by
+        # construction (same ops, split across two dispatches) — the bench
+        # embeds it as the no_fused_tail arm. Production stays fused.
+        self.fused_tail = bool(fused_tail)
+        if not self.fused_tail and draft_k:
+            raise ValueError(
+                "fused_tail=False (the A/B control) covers the plain decode "
+                "path only; speculative verify (draft_k > 0) is inseparable "
+                "from its in-program sampling"
+            )
         self.page_size = int(page_size)
         if kv_layout == "paged":
             if self.prefill_chunk == 0:
@@ -831,6 +863,7 @@ class ServingEngine:
         self._chunk_fused = _CHUNK_SHARED
         self._paged_chunk = _PAGED_CHUNK_SHARED
         self._spec = _SPEC_SHARED
+        self._sample_tail, self._forward_only = _DEFUSED_SHARED
         # compile-family sanitizer (analysis/runtime.py): each labeled jit
         # dispatch site declares the number of distinct cache signatures it
         # may legitimately produce over this engine's lifetime. The fixed-
@@ -842,6 +875,25 @@ class ServingEngine:
         self._ds_decode = bounded_dispatch("engine.decode_step", 1)
         self._ds_prefill = bounded_dispatch("engine.prefill_chunk", 1)
         self._ds_spec = bounded_dispatch("engine.spec_verify", 1)
+        # kernel-lane sites (PR 11): the defused control's standalone sample
+        # dispatch, and the paged-attention kernel's per-tick signature
+        # (table/pool/offset shapes — the kernel itself runs INSIDE the
+        # decode/spec program, so this site pins the host-visible inputs
+        # that select its compiled family)
+        self._ds_sample = bounded_dispatch("engine.sample_tail", 1)
+        self._ds_paged = bounded_dispatch("engine.paged_attention", 1)
+        # is the paged-attention kernel compiled into the decode program?
+        # Same gate the model consults (ops.pallas.paged_attention), so the
+        # exported gauge can never disagree with what actually traced.
+        from zero_transformer_tpu.ops.pallas import paged_attention as _pa
+
+        self._paged_kernel = kv_layout == "paged" and _pa.supported(
+            cfg.attention_impl,
+            T=1 + self.draft_k if self.draft_k else 1,
+            D=cfg.head_width,
+            page_size=self.page_size,
+            dtype=resolve_dtype(cfg.compute_dtype),
+        )
         # distinct one-shot prefill bucket lengths this engine has compiled
         # (legacy path); bounded by max_prefill_buckets + the capacity bucket
         self._buckets_seen: set = set()
@@ -1744,21 +1796,32 @@ class ServingEngine:
             if self.draft_k:
                 blocks, n_emits, bad_rows = self._dispatch_spec()
             else:
-                fused_args = (
-                    self.model,
-                    self.sampling,
-                    self.params,
-                    self._last_logits,
-                    self.slots.cache,
-                    self._gen_mask,
-                    self._rngs,
-                )
-                # skip model (0) + params (2) — engine-lifetime constants;
-                # sampling statics + cache/logits/mask/rng shapes remain
-                self._ds_decode.observe(fused_args[1], *fused_args[3:])
-                token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, bad = _in_mesh(
-                    self.mesh, self._fused, *fused_args
-                )
+                if self.fused_tail:
+                    fused_args = (
+                        self.model,
+                        self.sampling,
+                        self.params,
+                        self._last_logits,
+                        self.slots.cache,
+                        self._gen_mask,
+                        self._rngs,
+                    )
+                    # skip model (0) + params (2) — engine-lifetime
+                    # constants; sampling statics + cache/logits/mask/rng
+                    # shapes remain
+                    self._ds_decode.observe(fused_args[1], *fused_args[3:])
+                    if self._paged_kernel:
+                        # the paged kernel's compiled family is selected by
+                        # the table/pool shapes inside the cache tree plus
+                        # the decode window — pin them at bound 1
+                        self._ds_paged.observe(
+                            fused_args[4], 1 + self.draft_k
+                        )
+                    token, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, bad = _in_mesh(
+                        self.mesh, self._fused, *fused_args
+                    )
+                else:
+                    token, bad = self._dispatch_defused()
                 if self._chaos is not None:
                     # injected NaNs land AFTER the step, so re-run the same
                     # predicate over the poisoned logits — injected and organic
@@ -1930,6 +1993,8 @@ class ServingEngine:
         )
         # skip model (0) + params (3) — engine-lifetime constants
         self._ds_spec.observe(*spec_args[1:3], *spec_args[4:])
+        if self._paged_kernel:
+            self._ds_paged.observe(spec_args[5], 1 + K)
         x, n_acc, self._last_logits, self.slots.cache, self._gen_mask, self._rngs, self._veto, bad = _in_mesh(
             self.mesh, self._spec, *spec_args
         )
@@ -1952,6 +2017,28 @@ class ServingEngine:
                 self.stats["accepted_tokens"] += acc
                 n_emits[slot] = 1 + acc
         return blocks, n_emits, bad_rows
+
+    # graftlint: hot-path
+    def _dispatch_defused(self):
+        """The fused-tail A/B CONTROL (``fused_tail=False``): the same tick
+        math as the fused step, split into a standalone sample dispatch and
+        a forward-only dispatch — what every token cost before sampling
+        moved into the decode program. Trajectories stay byte-identical to
+        the fused path (identical ops, identical rng split order); only the
+        dispatch count (and the [S] token round-trip between the two
+        programs) differs, which is exactly what the bench's
+        ``no_fused_tail`` arm prices."""
+        tail_args = (self.sampling, self._last_logits, self._gen_mask, self._rngs)
+        self._ds_sample.observe(*tail_args)
+        token, self._gen_mask, self._rngs = _in_mesh(
+            self.mesh, self._sample_tail, *tail_args
+        )
+        fwd_args = (self.model, self.params, token, self.slots.cache)
+        self._ds_decode.observe(fwd_args[2], fwd_args[3])
+        self._last_logits, self.slots.cache, bad = _in_mesh(
+            self.mesh, self._forward_only, *fwd_args
+        )
+        return token, bad
 
     def _grow_decode_pages(self) -> None:
         """Paged: extend each decoding slot's block table to cover this
@@ -2062,6 +2149,7 @@ class ServingEngine:
             # is the same executable family — swap it with its twin)
             self._fused = _jit_fused_step()
             self._spec = _jit_spec_step()
+            self._sample_tail, self._forward_only = _jit_defused_pair()
         # device buffers are suspect after EVERY fused-call fault, threshold
         # or not: the step donates logits/cache/masks/rngs, so an exception
         # after dispatch leaves them deleted or half-written — reusing them
@@ -2381,11 +2469,18 @@ class ServingEngine:
                 if self.stats["draft_tokens"]
                 else 0.0
             ),
+            # kernel-lane gauges (PR 11): is the paged-attention kernel
+            # compiled into the decode program (vs the gather-to-slab
+            # fallback), and is the sampling tail fused (vs the A/B
+            # control's split dispatches)?
+            "kernel_paged_attention": int(self._paged_kernel),
+            "fused_tail": int(self.fused_tail),
         }
         # compile-family sanitizer gauges: distinct jit signatures seen per
         # labeled dispatch site vs its declared bound; a nonzero violation
         # count is the "serving got slow" compile-storm smoking gun
-        for site in (self._ds_decode, self._ds_prefill, self._ds_spec):
+        for site in (self._ds_decode, self._ds_prefill, self._ds_spec,
+                     self._ds_sample, self._ds_paged):
             short = site.name.rsplit(".", 1)[-1]
             snap[f"dispatch_{short}_signatures"] = site.distinct
             snap[f"dispatch_{short}_violations"] = site.violations
