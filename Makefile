@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos obs bench bench-watch serve-bench train-bench kernel-bench e2e-watch fmt fmt-check dryrun lint
+.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos disagg-chaos obs bench bench-watch serve-bench train-bench kernel-bench e2e-watch fmt fmt-check dryrun lint
 
 # Invariant lint lane (ISSUE 10): graftlint's repo-specific AST rules +
 # the suppression audit over the whole tree. Pure stdlib — no jax import,
@@ -62,6 +62,17 @@ serve-chaos:
 router-chaos:
 	$(PY) -m pytest tests/test_router.py -q -m chaos $(PYTEST_ARGS)
 
+# Disaggregated-fleet fault-injection lane (ISSUE 12): SIGKILL a
+# prefill-role replica mid-long-prompt-flood (every stream finishes
+# token-exact or ends retryably through the recompute fallback, zero
+# drops, the fleet keeps serving without its prefill tier), and kill a
+# migration's TARGET mid-transfer (the ship fails, the source degrades the
+# stream retryably, the router's recompute fallback resumes it token-exact
+# on a survivor). The fast deterministic disagg cases (page-span roundtrip,
+# migration parity, autoscaler logic) are un-marked and run in the quick lane.
+disagg-chaos:
+	$(PY) -m pytest tests/test_serving_disagg.py -q -m chaos $(PYTEST_ARGS)
+
 # Observability lane (ISSUE 7): the obs test file (span-tree parity over
 # every request outcome, Prometheus exposition conformance under live
 # traffic, X-Request-Id round trip, flight-recorder dump on breaker-open,
@@ -94,7 +105,13 @@ bench:
 #    aggregate relayed tok/s at 1/2/4 replicas + token-exact mid-stream
 #    failover + rolling reload with zero drops -> BENCH_router.json (the
 #    guard holds the >= 3x near-linear bar on matching hardware and the
-#    correctness fields everywhere).
+#    correctness fields everywhere);
+#  - disaggregation A/B + autoscale sawtooth (ISSUE 12): a long-prompt
+#    flood against a mixed fleet vs a prefill/decode split fleet (real
+#    engines, token-exact, zero replayed tokens), plus the autoscaler
+#    tracking a sawtooth on stub replicas with zero drops
+#    -> BENCH_disagg.json (isolation ratios graded on accelerators only —
+#    on a shared-core CPU box both replicas compete for the same cores).
 # A regression guard compares the fresh runs against the previously
 # committed artifacts (>15% on decode_tok_s / itl p99 / capacity ratio /
 # router scaling fails loudly on matching hardware, skips otherwise).
@@ -103,6 +120,7 @@ serve-bench:
 	@cp BENCH_serve.json /tmp/_serve_baseline.json 2>/dev/null || true
 	@cp BENCH_serve_capacity.json /tmp/_serve_cap_baseline.json 2>/dev/null || true
 	@cp BENCH_router.json /tmp/_serve_router_baseline.json 2>/dev/null || true
+	@cp BENCH_disagg.json /tmp/_serve_disagg_baseline.json 2>/dev/null || true
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 8 --slots 2 \
 		--spec-k 4 --greedy --max-new-tokens 32 --cache-len 64 --obs-ab \
 		--fused-tail-ab
@@ -111,6 +129,8 @@ serve-bench:
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --capacity-sweep \
 		--cache-len 128 --max-new-tokens 8
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --router
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --long-prompt-flood \
+		--sawtooth --cache-len 64 --max-new-tokens 12 --slots 2
 	@if [ -f /tmp/_serve_baseline.json ]; then \
 		$(PY) scripts/serve_bench_guard.py /tmp/_serve_baseline.json BENCH_serve.json; \
 	else \
@@ -125,6 +145,11 @@ serve-bench:
 		$(PY) scripts/serve_bench_guard.py /tmp/_serve_router_baseline.json BENCH_router.json; \
 	else \
 		echo "serve-bench-guard: no committed router baseline; skipping"; \
+	fi
+	@if [ -f /tmp/_serve_disagg_baseline.json ]; then \
+		$(PY) scripts/serve_bench_guard.py /tmp/_serve_disagg_baseline.json BENCH_disagg.json; \
+	else \
+		echo "serve-bench-guard: no committed disagg baseline; skipping"; \
 	fi
 
 # Training step-time decomposition lane (ISSUE 8): overlap-on/off A/B with

@@ -112,9 +112,139 @@ def compare_router(
     return ok, msgs
 
 
+def compare_disagg(
+    baseline: dict, fresh: dict, tolerance: float = TOLERANCE,
+    grade_perf: bool = True,
+):
+    """BENCH_disagg.json pair (ISSUE 12). Correctness grades on ANY
+    hardware: every stream token-exact and finished, zero dropped streams,
+    the disaggregated arm actually split requests with ZERO replayed
+    tokens, and the sawtooth segment scaled up AND back down without
+    drops. The within-artifact A/B (the disaggregated arm must isolate
+    background decode from the flood at least as well as the mixed-fleet
+    control) also grades everywhere — both arms ran minutes apart on the
+    same box, like the obs-overhead A/B. Only the cross-run degradation
+    ratio vs the committed baseline is hardware-gated."""
+    msgs = []
+    ok = True
+    flood = fresh.get("flood") or {}
+    if flood:
+        if not flood.get("token_exact"):
+            ok = False
+            msgs.append("FAIL: flood arm streams were not token-exact")
+        if flood.get("dropped_streams", -1) != 0:
+            ok = False
+            msgs.append(
+                f"FAIL: flood dropped_streams="
+                f"{flood.get('dropped_streams')} (must be 0)"
+            )
+        disagg = flood.get("disagg") or {}
+        mixed = flood.get("mixed") or {}
+        if not disagg.get("disagg_dispatches"):
+            ok = False
+            msgs.append("FAIL: disagg arm never split a request by phase")
+        if disagg.get("resume_replayed_tokens", -1) != 0:
+            ok = False
+            msgs.append(
+                "FAIL: disagg arm replayed "
+                f"{disagg.get('resume_replayed_tokens')} tokens (must be 0)"
+            )
+        d_deg = disagg.get("itl_bg_p50_degradation", 0)
+        m_deg = mixed.get("itl_bg_p50_degradation", 0)
+        on_cpu = (fresh.get("platform") or {}).get("backend") == "cpu"
+        if d_deg and m_deg and on_cpu:
+            # CPU-honesty (the BENCH_ckpt_integrity / train_bench
+            # discipline): on a shared-core CPU box both "replicas"
+            # compete for the same cores, so the flood steals cycles from
+            # the decode replica whatever process it lives in — phase
+            # isolation is a DEVICE-parallelism claim and measuring it
+            # here is scheduler noise (observed flipping run to run).
+            # Correctness still graded above; ratios recorded, not graded.
+            msgs.append(
+                f"SKIP: cpu backend — isolation ratio recorded "
+                f"(disagg {d_deg:.2f}x vs mixed {m_deg:.2f}x) but not "
+                "graded; replicas share the same cores here"
+            )
+        elif d_deg and m_deg:
+            budget = max(m_deg * (1 + tolerance), 1.5)
+            if d_deg > budget:
+                ok = False
+                msgs.append(
+                    f"REGRESSION: disagg ITL degradation {d_deg:.2f}x under "
+                    f"flood exceeds the mixed control's {m_deg:.2f}x "
+                    f"(budget {budget:.2f}x) — disaggregation stopped "
+                    "isolating decode"
+                )
+            else:
+                msgs.append(
+                    f"ok: flood stretches background decode ITL p50 "
+                    f"{d_deg:.2f}x disaggregated vs {m_deg:.2f}x mixed"
+                )
+    saw = fresh.get("sawtooth") or {}
+    if saw:
+        if saw.get("dropped_streams", -1) != 0 or saw.get("hung"):
+            ok = False
+            msgs.append(f"FAIL: sawtooth dropped/hung streams: {saw}")
+        if saw.get("streams_done") != saw.get("streams"):
+            ok = False
+            msgs.append(
+                f"FAIL: sawtooth finished {saw.get('streams_done')} of "
+                f"{saw.get('streams')} streams"
+            )
+        if not saw.get("autoscale_ups") or not saw.get("autoscale_downs"):
+            ok = False
+            msgs.append(
+                "FAIL: autoscaler never tracked the sawtooth "
+                f"(ups={saw.get('autoscale_ups')}, "
+                f"downs={saw.get('autoscale_downs')})"
+            )
+        else:
+            msgs.append(
+                f"ok: sawtooth tracked (ups={saw['autoscale_ups']}, "
+                f"downs={saw['autoscale_downs']}, dropped 0)"
+            )
+    if not grade_perf:
+        msgs.append(
+            "SKIP: hardware mismatch vs baseline; cross-run degradation "
+            "not graded (correctness + within-artifact A/B were)"
+        )
+        return ok, msgs
+    base_deg = (
+        (baseline.get("flood") or {}).get("disagg") or {}
+    ).get("itl_bg_p50_degradation", 0)
+    fresh_deg = (
+        (fresh.get("flood") or {}).get("disagg") or {}
+    ).get("itl_bg_p50_degradation", 0)
+    if (fresh.get("platform") or {}).get("backend") == "cpu":
+        base_deg = 0  # same shared-core honesty as the within-artifact A/B
+    if base_deg and fresh_deg and fresh_deg > base_deg * (1 + tolerance):
+        ok = False
+        msgs.append(
+            f"REGRESSION: disagg ITL degradation {fresh_deg:.2f}x > "
+            f"{(1 + tolerance) * 100:.0f}% of baseline {base_deg:.2f}x"
+        )
+    elif base_deg and fresh_deg:
+        msgs.append(
+            f"ok: disagg ITL degradation {fresh_deg:.2f}x "
+            f"(baseline {base_deg:.2f}x)"
+        )
+    return ok, msgs
+
+
 def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
     """Returns (ok, messages). ok=True covers both pass and skip."""
     msgs = []
+    # the disagg artifact dispatches before the generic platform gate too:
+    # its correctness fields + within-artifact A/B grade everywhere
+    if str(fresh.get("metric", "")) == "disagg_flood_and_autoscale":
+        grade = (
+            baseline.get("metric") == fresh.get("metric")
+            and bool(baseline.get("platform"))
+            and baseline.get("platform") == fresh.get("platform")
+        )
+        return compare_disagg(
+            baseline if grade else {}, fresh, tolerance, grade_perf=grade
+        )
     # the router artifact dispatches before the generic platform gate: its
     # correctness fields must grade everywhere, only its scaling perf is
     # hardware-gated

@@ -112,6 +112,22 @@ def parse_args(argv=None):
                    help="decode slots for the PAGED engine in the sweep "
                         "(its concurrency ceiling; the slab engine's slot "
                         "count is fixed by the memory budget)")
+    p.add_argument("--long-prompt-flood", action="store_true",
+                   help="disaggregation A/B (-> BENCH_disagg.json): a "
+                        "long-prompt flood against a MIXED 2-replica fleet "
+                        "vs a PREFILL+DECODE disaggregated fleet (real "
+                        "engines behind the real router); records flood "
+                        "TTFT and the background streams' decode-only ITL "
+                        "per arm, plus the no-flood ITL baseline")
+    p.add_argument("--sawtooth", action="store_true",
+                   help="autoscale tracking segment (-> BENCH_disagg.json): "
+                        "a sawtooth load against a stub fleet with the "
+                        "router's autoscaler spawning/retiring replicas; "
+                        "proof is tracking with dropped_streams == 0")
+    p.add_argument("--flood-background", type=int, default=2,
+                   help="decode-heavy background streams per flood arm")
+    p.add_argument("--flood-requests", type=int, default=3,
+                   help="long-prompt flood arrivals per arm")
     p.add_argument("--router", action="store_true",
                    help="fleet-router mode: spawn N in-process PACED stub "
                         "replicas (fixed inter-token interval — models "
@@ -766,6 +782,416 @@ def run_router_bench(args) -> dict:
     return artifact
 
 
+# --------------------------------------------- disaggregated fleet (ISSUE 12)
+
+
+def _pcts(values, qs=(50, 99)):
+    import math
+
+    if not values:
+        return {f"p{q}": 0.0 for q in qs}
+    ordered = sorted(values)
+    out = {}
+    for q in qs:
+        rank = max(
+            0, min(len(ordered) - 1, math.ceil(q / 100 * len(ordered)) - 1)
+        )
+        out[f"p{q}"] = round(ordered[rank], 3)
+    return out
+
+
+def _sse_timed(port: int, body: dict, timeout: float = 600.0):
+    """SSE client recording each token's ARRIVAL time: returns
+    (ids, stamps, done_event)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/generate", json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if "text/event-stream" not in (resp.getheader("Content-Type") or ""):
+            return [], [], json.loads(resp.read() or b"{}")
+        ids, stamps, done = [], [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data: "):
+                continue
+            event = json.loads(line[6:])
+            if event.get("done"):
+                done = event
+                break
+            if "token" in event:
+                ids.append(int(event["token"]))
+                stamps.append(time.monotonic())
+        return ids, stamps, done
+    finally:
+        conn.close()
+
+
+class _IdTokenizer:
+    eos_token_id = None
+
+    def encode(self, text):
+        return [1 + (b % 250) for b in text.encode()]
+
+    def decode(self, ids, **kw):
+        return "".join(f"<{t}>" for t in ids)
+
+    def convert_ids_to_tokens(self, ids):
+        return [f"<{t}>" for t in ids]
+
+    def convert_tokens_to_string(self, toks):
+        return "".join(toks)
+
+
+def _run_flood_arm(cfg, params, sampling, cache_len, args, roles, label):
+    """One fleet arm of the long-prompt-flood A/B: build the fleet (REAL
+    engines + servers + router), measure (a) the no-flood decode-only ITL
+    baseline, then (b) background ITL + flood TTFT with the flood live.
+    Client-side clocks: the numbers are what a caller would see."""
+    from zero_transformer_tpu.serving import (
+        RouterServer,
+        ServingEngine,
+        ServingServer,
+    )
+
+    servers = []
+    for role in roles:
+        engine = ServingEngine(
+            cfg, params, n_slots=args.slots, cache_len=cache_len,
+            sampling=sampling, prefill_chunk=args.prefill_chunk,
+            prefix_cache_chunks=0, kv_layout="paged",
+            page_size=args.page_size, role=role,
+        )
+        server = ServingServer(engine, _IdTokenizer(), port=0)
+        server.start()
+        servers.append(server)
+    router = RouterServer(
+        [f"127.0.0.1:{s.port}" for s in servers],
+        probe_interval=0.05, chunk_tokens=args.prefill_chunk,
+        stream_timeout=600.0, max_attempts=4,
+    )
+    router.start()
+    try:
+        if not router.wait_ready(60):
+            raise SystemExit(f"DISAGG BENCH FAILED: {label} fleet not ready")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and any(
+            r.role != roles[i]
+            for i, r in enumerate(router.registry.replicas.values())
+        ):
+            time.sleep(0.05)
+        # warm every compile family outside the measured window
+        bg_prompt = [7, 11, 13, 17, 19, 23]
+        long_len = 3 * args.prefill_chunk + 2
+        _sse_timed(router.port, {"tokens": bg_prompt, "max_new_tokens": 2})
+        _sse_timed(router.port, {
+            "tokens": [(29 + i) % 250 + 1 for i in range(long_len)],
+            "max_new_tokens": 2,
+        })
+
+        bg_new = args.max_new_tokens * 2
+        lock = threading.Lock()
+
+        def background(i, sink):
+            prompt = bg_prompt + [31 + i]
+            ids, stamps, done = _sse_timed(router.port, {
+                "tokens": prompt, "max_new_tokens": bg_new, "seed": i,
+            })
+            with lock:
+                sink.append((prompt, bg_new, i, ids, stamps, done))
+
+        # ---- no-flood baseline: background streams alone
+        base_runs: list = []
+        threads = [
+            threading.Thread(target=background, args=(i, base_runs), daemon=True)
+            for i in range(args.flood_background)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        base_gaps = [
+            (b - a) * 1e3
+            for _, _, _, _, stamps, _ in base_runs
+            for a, b in zip(stamps, stamps[1:])
+        ]
+
+        # ---- flood phase: background + long-prompt arrivals
+        bg_runs: list = []
+        flood_runs: list = []
+        threads = [
+            threading.Thread(
+                target=background, args=(100 + i, bg_runs), daemon=True
+            )
+            for i in range(args.flood_background)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+
+        def flood(i):
+            prompt = [(37 + i + j) % 250 + 1 for j in range(long_len)]
+            t0 = time.monotonic()
+            ids, stamps, done = _sse_timed(router.port, {
+                "tokens": prompt, "max_new_tokens": 4, "seed": 0,
+            })
+            ttft = (stamps[0] - t0) * 1e3 if stamps else float("inf")
+            with lock:
+                flood_runs.append((prompt, 4, ttft, ids, done))
+
+        fthreads = [
+            threading.Thread(target=flood, args=(i,), daemon=True)
+            for i in range(args.flood_requests)
+        ]
+        for t in fthreads:
+            t.start()
+        for t in fthreads + threads:
+            t.join(timeout=600)
+        hung = sum(1 for t in fthreads + threads if t.is_alive())
+        flood_gaps = [
+            (b - a) * 1e3
+            for _, _, _, _, stamps, _ in bg_runs
+            for a, b in zip(stamps, stamps[1:])
+        ]
+        all_done = all(
+            done is not None and done.get("status") == "done"
+            for _, _, _, _, _, done in base_runs + bg_runs
+        ) and all(
+            done is not None and done.get("status") == "done"
+            for _, _, _, _, done in flood_runs
+        )
+        streams = [
+            (prompt, max_new, 0, ids)
+            for prompt, max_new, _, ids, _ in flood_runs
+        ] + [
+            (prompt, max_new, seed, ids)
+            for prompt, max_new, seed, ids, _, _ in base_runs + bg_runs
+        ]
+        return {
+            "roles": list(roles),
+            "itl_ms_decode_bg_no_flood": _pcts(base_gaps),
+            "itl_ms_decode_bg_flood": _pcts(flood_gaps),
+            "ttft_ms_flood": _pcts([t for _, _, t, _, _ in flood_runs]),
+            "streams_done": all_done,
+            "hung": hung,
+            "dropped_streams": router.stats["dropped_streams"],
+            "disagg_dispatches": router.stats["disagg_dispatches"],
+            "resume_replayed_tokens": router.stats["resume_replayed_tokens"],
+        }, streams
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def _run_sawtooth_segment(args) -> dict:
+    """Autoscale tracking: stub replicas (paced, device-speed-independent)
+    behind the router's autoscaler; a burst phase must scale the fleet up
+    and an idle phase must scale it back down, with zero dropped streams."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_router", REPO / "scripts" / "serve_router.py"
+    )
+    serve_router = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_router)
+    from zero_transformer_tpu.serving import RouterServer
+
+    live = []
+
+    class _Scaler:
+        def spawn(self):
+            stub = serve_router.StubReplica(itl_s=0.004, slots=1).start()
+            live.append(stub)
+            return f"127.0.0.1:{stub.port}"
+
+        def retire(self, url):
+            port = int(url.rsplit(":", 1)[1])
+            for stub in live:
+                if stub.port == port:
+                    stub.stop()
+
+    seed_stub = serve_router.StubReplica(itl_s=0.004, slots=1).start()
+    live.append(seed_stub)
+    router = RouterServer(
+        [f"127.0.0.1:{seed_stub.port}"],
+        probe_interval=0.05, chunk_tokens=4, stream_timeout=120.0,
+        scaler=_Scaler(), autoscale_interval=0.15, scale_patience=2,
+        scale_up_queue=1.0, scale_down_active=0, min_replicas=1,
+        max_replicas=3, scale_drain_timeout_s=10.0,
+    )
+    router.start()
+    trace = []
+    stop_sampling = threading.Event()
+
+    def sample():
+        t0 = time.monotonic()
+        while not stop_sampling.wait(0.1):
+            trace.append([
+                round(time.monotonic() - t0, 2),
+                len(router.registry.routable()),
+                sum(r.queue_depth for r in router.registry.routable()),
+            ])
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    try:
+        if not router.wait_ready(30):
+            raise SystemExit("DISAGG BENCH FAILED: sawtooth fleet not ready")
+        results: list = []
+        lock = threading.Lock()
+
+        def client(i):
+            ids, done = _sse_collect(router.port, {
+                "tokens": [10 + i] * 4, "max_new_tokens": 24,
+            }, timeout=300)
+            with lock:
+                results.append((ids, done))
+
+        # tooth 1: a burst well past one stub's capacity
+        burst = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join(timeout=300)
+        # trough: idle until the autoscaler retires the extra capacity
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(router.registry) > 1:
+            time.sleep(0.1)
+        # tooth 2: prove the shrunk fleet still tracks a second burst
+        burst2 = [
+            threading.Thread(target=client, args=(20 + i,), daemon=True)
+            for i in range(6)
+        ]
+        for t in burst2:
+            t.start()
+        for t in burst2:
+            t.join(timeout=300)
+        stop_sampling.set()
+        sampler.join(timeout=5)
+        hung = sum(1 for t in burst + burst2 if t.is_alive())
+        done_n = sum(
+            1 for _, done in results
+            if done is not None and done.get("status") == "done"
+        )
+        return {
+            "streams": len(burst) + len(burst2),
+            "streams_done": done_n,
+            "hung": hung,
+            "dropped_streams": router.stats["dropped_streams"],
+            "autoscale_ups": router.stats["autoscale_ups"],
+            "autoscale_downs": router.stats["autoscale_downs"],
+            "autoscale_aborts": router.stats["autoscale_aborts"],
+            "max_replicas_seen": max((n for _, n, _ in trace), default=1),
+            "min_replicas_seen": min((n for _, n, _ in trace), default=1),
+            "replica_trace": trace,
+        }
+    finally:
+        stop_sampling.set()
+        router.stop()
+        for stub in live:
+            stub.stop()
+
+
+def run_disagg_bench(args) -> dict:
+    """BENCH_disagg.json: the disaggregation A/B (mixed fleet control vs
+    prefill/decode split under a long-prompt flood) and the sawtooth
+    autoscale segment. Correctness is hard-enforced at write time: every
+    stream done, token-exact vs ``generate()`` (greedy), zero drops, zero
+    replayed tokens on the disaggregated arm."""
+    args.greedy = True  # token-exactness is part of the artifact's claim
+    cfg, params, sampling, cache_len, _ = build(args)
+    artifact: dict = {
+        "bench": "serve_disagg",
+        "metric": "disagg_flood_and_autoscale",
+        "platform": _platform_block(),
+        "config": {
+            "model": args.model, "slots": args.slots,
+            "prefill_chunk": args.prefill_chunk,
+            "page_size": args.page_size,
+            "background_streams": args.flood_background,
+            "flood_requests": args.flood_requests,
+        },
+    }
+    failures = []
+    if args.long_prompt_flood:
+        mixed, mixed_streams = _run_flood_arm(
+            cfg, params, sampling, cache_len, args,
+            ("mixed", "mixed"), "mixed",
+        )
+        disagg, dis_streams = _run_flood_arm(
+            cfg, params, sampling, cache_len, args,
+            ("prefill", "decode"), "disagg",
+        )
+        # token-exactness vs generate() — the phase split must be
+        # INVISIBLE in the bytes (greedy): every stream of BOTH arms
+        refs: dict = {}
+
+        def ref(prompt, max_new, seed):
+            key = (tuple(prompt), max_new, seed)
+            if key not in refs:
+                refs[key] = reference_outputs(
+                    cfg, params, sampling, cache_len,
+                    [(list(prompt), seed)], max_new,
+                )[0]
+            return refs[key]
+
+        token_exact = all(
+            arm["streams_done"] and not arm["hung"]
+            for arm in (mixed, disagg)
+        ) and all(
+            ids == ref(prompt, max_new, seed)
+            for prompt, max_new, seed, ids in mixed_streams + dis_streams
+        )
+        # the headline: how much did the flood stretch the background
+        # streams' decode ITL in each arm? (1.0 = perfectly isolated)
+        for arm in (mixed, disagg):
+            base = arm["itl_ms_decode_bg_no_flood"]["p50"] or 1e-9
+            arm["itl_bg_p50_degradation"] = round(
+                arm["itl_ms_decode_bg_flood"]["p50"] / base, 3
+            )
+        artifact["flood"] = {
+            "mixed": mixed,
+            "disagg": disagg,
+            "token_exact": token_exact,
+            "dropped_streams": (
+                mixed["dropped_streams"] + disagg["dropped_streams"]
+            ),
+        }
+        if not token_exact:
+            failures.append("flood arm had hung/failed streams")
+        if mixed["dropped_streams"] or disagg["dropped_streams"]:
+            failures.append("flood arm dropped streams")
+        if not disagg["disagg_dispatches"]:
+            failures.append("disagg arm never split a request")
+        if disagg["resume_replayed_tokens"]:
+            failures.append("disagg arm replayed tokens")
+    if args.sawtooth:
+        saw = _run_sawtooth_segment(args)
+        artifact["sawtooth"] = saw
+        if saw["dropped_streams"]:
+            failures.append("sawtooth dropped streams")
+        if saw["hung"] or saw["streams_done"] != saw["streams"]:
+            failures.append("sawtooth streams did not all finish")
+        if not saw["autoscale_ups"] or not saw["autoscale_downs"]:
+            failures.append("autoscaler never acted (no up or no down)")
+    out = Path(args.out)
+    out.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    if failures:
+        raise SystemExit("DISAGG BENCH FAILED: " + "; ".join(failures))
+    return artifact
+
+
 def main(argv=None) -> dict:
     args = parse_args(argv)
     # some images pre-import jax with a platform baked into jax.config,
@@ -784,6 +1210,10 @@ def main(argv=None) -> dict:
         if args.out == str(REPO / "BENCH_serve.json"):  # untouched default
             args.out = str(REPO / "BENCH_router.json")
         return run_router_bench(args)
+    if args.long_prompt_flood or args.sawtooth:
+        if args.out == str(REPO / "BENCH_serve.json"):  # untouched default
+            args.out = str(REPO / "BENCH_disagg.json")
+        return run_disagg_bench(args)
     cfg, params, sampling, cache_len, make_engine = build(args)
     if args.capacity_sweep:
         if args.out == str(REPO / "BENCH_serve.json"):  # untouched default

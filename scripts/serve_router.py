@@ -315,6 +315,7 @@ def run_replica_worker(args) -> None:
         prefix_cache_chunks=args.prefix_cache if args.prefill_chunk else 0,
         kv_layout="paged" if args.prefill_chunk else "slab",
         page_size=args.page_size,
+        role=args.role,
     )
 
     class _TokenTokenizer:
@@ -385,6 +386,18 @@ def main(argv=None) -> None:
     p.add_argument("--admin-token", default=None)
     p.add_argument("--obs-dir", default=None,
                    help="flight-recorder dumps (replica ejections) + traces")
+    p.add_argument("--disaggregate", default="auto",
+                   choices=("auto", "off"),
+                   help="split requests prefill/decode by phase whenever the "
+                        "fleet advertises both roles on /healthz (auto), or "
+                        "force the classic single-replica path (off)")
+    p.add_argument("--no-migrate-drain", action="store_true",
+                   help="rolling reload: wait out in-flight generations "
+                        "instead of migrating them (the pre-PR12 behavior)")
+    p.add_argument("--role", default="mixed",
+                   choices=("mixed", "prefill", "decode"),
+                   help="replica-worker mode: the engine role "
+                        "(see serve --role)")
     # harness modes (testing / benching):
     p.add_argument("--replica-worker", action="store_true",
                    help=argparse.SUPPRESS)
@@ -429,6 +442,8 @@ def main(argv=None) -> None:
         connect_timeout=args.connect_timeout,
         stream_timeout=args.stream_timeout, admin_token=args.admin_token,
         obs_dir=args.obs_dir,
+        disaggregate=args.disaggregate,
+        migrate_drain=not args.no_migrate_drain,
     )
 
 

@@ -60,6 +60,19 @@ ROUTER_REQUIRED_KEYS = {
     "dropped_streams", "platform", "measured_at_utc",
 }
 
+DISAGG_REQUIRED_KEYS = {"bench", "metric", "platform", "config", "flood",
+                        "sawtooth"}
+DISAGG_FLOOD_ARM_KEYS = {
+    "roles", "itl_ms_decode_bg_no_flood", "itl_ms_decode_bg_flood",
+    "ttft_ms_flood", "itl_bg_p50_degradation", "streams_done", "hung",
+    "dropped_streams", "disagg_dispatches", "resume_replayed_tokens",
+}
+DISAGG_SAWTOOTH_KEYS = {
+    "streams", "streams_done", "hung", "dropped_streams", "autoscale_ups",
+    "autoscale_downs", "autoscale_aborts", "max_replicas_seen",
+    "min_replicas_seen", "replica_trace",
+}
+
 
 def _load():
     spec = importlib.util.spec_from_file_location(
@@ -307,6 +320,122 @@ def test_loadgen_router_artifact(tmp_path):
     assert artifact["rolling_reload"]["dropped_streams"] == 0
     assert artifact["dropped_streams"] == 0
     assert set(artifact["platform"]) == {"backend", "device"}
+
+
+def test_committed_disagg_artifact_schema():
+    """BENCH_disagg.json (ISSUE 12): schema + the correctness invariants
+    the acceptance bar names — token-exact phase split with zero replayed
+    tokens, zero dropped streams, and a sawtooth the autoscaler tracked."""
+    path = REPO / "BENCH_disagg.json"
+    assert path.exists(), "commit BENCH_disagg.json (make disagg-bench)"
+    artifact = json.loads(path.read_text())
+    missing = DISAGG_REQUIRED_KEYS - set(artifact)
+    assert not missing, f"disagg artifact missing keys: {sorted(missing)}"
+    assert artifact["metric"] == "disagg_flood_and_autoscale"
+    flood = artifact["flood"]
+    for arm in ("mixed", "disagg"):
+        missing = DISAGG_FLOOD_ARM_KEYS - set(flood[arm])
+        assert not missing, f"{arm} arm missing: {sorted(missing)}"
+    assert flood["token_exact"] is True
+    assert flood["dropped_streams"] == 0
+    assert flood["disagg"]["disagg_dispatches"] > 0
+    assert flood["disagg"]["resume_replayed_tokens"] == 0
+    assert flood["mixed"]["disagg_dispatches"] == 0  # the control is pure
+    saw = artifact["sawtooth"]
+    missing = DISAGG_SAWTOOTH_KEYS - set(saw)
+    assert not missing, f"sawtooth missing: {sorted(missing)}"
+    assert saw["dropped_streams"] == 0 and saw["hung"] == 0
+    assert saw["streams_done"] == saw["streams"]
+    assert saw["autoscale_ups"] >= 1 and saw["autoscale_downs"] >= 1
+    assert saw["max_replicas_seen"] > saw["min_replicas_seen"]
+    assert set(artifact["platform"]) == {"backend", "device"}
+
+
+def test_loadgen_sawtooth_segment_live(tmp_path):
+    """The autoscale segment end to end on stub replicas: the control loop
+    must spawn under the burst, retire in the trough, and drop nothing.
+    (The flood A/B runs real engines and lives in make disagg-bench; its
+    committed artifact is schema-checked above.)"""
+    loadgen = _load()
+    out = tmp_path / "BENCH_disagg.json"
+    artifact = loadgen.main(["--sawtooth", "--out", str(out)])
+    on_disk = json.loads(out.read_text())
+    assert on_disk == artifact
+    saw = artifact["sawtooth"]
+    assert saw["dropped_streams"] == 0
+    assert saw["streams_done"] == saw["streams"]
+    assert saw["autoscale_ups"] >= 1 and saw["autoscale_downs"] >= 1
+
+
+def test_serve_bench_guard_disagg_logic():
+    """Disagg-artifact guard branch: correctness + the within-artifact A/B
+    grade on ANY hardware; only the cross-run ratio is platform-gated."""
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_guard", REPO / "scripts" / "serve_bench_guard.py"
+    )
+    guard = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(guard)
+
+    def arm(deg, dispatches=0, replayed=0):
+        return {
+            "itl_bg_p50_degradation": deg,
+            "disagg_dispatches": dispatches,
+            "resume_replayed_tokens": replayed,
+            "streams_done": True, "hung": 0, "dropped_streams": 0,
+        }
+
+    good = {
+        "metric": "disagg_flood_and_autoscale",
+        "platform": {"backend": "cpu", "device": "x"},
+        "flood": {
+            "token_exact": True, "dropped_streams": 0,
+            "mixed": arm(1.8), "disagg": arm(1.1, dispatches=5),
+        },
+        "sawtooth": {
+            "streams": 12, "streams_done": 12, "hung": 0,
+            "dropped_streams": 0, "autoscale_ups": 2, "autoscale_downs": 1,
+        },
+    }
+    ok, _ = guard.compare(good, json.loads(json.dumps(good)))
+    assert ok
+    # dropped streams fail on any hardware
+    bad = json.loads(json.dumps(good))
+    bad["flood"]["dropped_streams"] = 1
+    ok, msgs = guard.compare(good, bad)
+    assert not ok and any("dropped" in m for m in msgs)
+    # replayed tokens on the disagg arm fail (the zero-recompute claim)
+    bad = json.loads(json.dumps(good))
+    bad["flood"]["disagg"]["resume_replayed_tokens"] = 40
+    ok, msgs = guard.compare(good, bad)
+    assert not ok and any("replayed" in m for m in msgs)
+    # on a CPU box the isolation ratio is recorded but NOT graded (both
+    # replicas share the same cores — scheduler noise, not isolation)
+    noisy = json.loads(json.dumps(good))
+    noisy["flood"]["disagg"]["itl_bg_p50_degradation"] = 9.0
+    ok, msgs = guard.compare(good, noisy)
+    assert ok and any("share the same cores" in m for m in msgs)
+    # on an accelerator the within-artifact A/B grades — even when the
+    # baseline came from foreign hardware
+    tpu = json.loads(json.dumps(good))
+    tpu["platform"] = {"backend": "tpu", "device": "v4"}
+    bad = json.loads(json.dumps(tpu))
+    bad["flood"]["disagg"]["itl_bg_p50_degradation"] = 9.0
+    ok, msgs = guard.compare(good, bad)
+    assert not ok and any("isolating" in m for m in msgs)
+    # an idle autoscaler fails: the sawtooth exists to prove tracking
+    bad = json.loads(json.dumps(good))
+    bad["sawtooth"]["autoscale_downs"] = 0
+    ok, msgs = guard.compare(good, bad)
+    assert not ok and any("autoscaler" in m for m in msgs)
+    # cross-run regression: graded on matching ACCELERATOR hardware...
+    worse = json.loads(json.dumps(tpu))
+    worse["flood"]["disagg"]["itl_bg_p50_degradation"] = 1.4
+    ok, msgs = guard.compare(tpu, worse)
+    assert not ok and any("baseline" in m for m in msgs)
+    # ...and skipped across a hardware mismatch
+    worse["platform"] = {"backend": "tpu", "device": "v5e"}
+    ok, msgs = guard.compare(tpu, worse)
+    assert ok and any("SKIP" in m for m in msgs)
 
 
 def test_serve_bench_guard_router_logic():

@@ -530,6 +530,13 @@ class ServingConfig:
     # only so the bench can price the fusion (BENCH_serve.json's
     # no_fused_tail arm); byte-identical trajectories either way.
     fused_tail: bool = True
+    # disaggregated fleets (PR 12): a "prefill" replica runs only chunked
+    # prefill at max batch and ships every finished stream's KV pages to
+    # the decode replica the request names; a "decode" replica serves
+    # imported streams (and plain requests, as the recompute fallback);
+    # "mixed" is the classic single-replica behavior. Non-mixed roles
+    # require the paged KV layout — pages are the unit that ships.
+    role: str = "mixed"
 
     def __post_init__(self):
         if self.slots < 1:
@@ -585,6 +592,20 @@ class ServingConfig:
                 "serving.fused_tail=False (the A/B control) covers the "
                 "plain decode path only; speculative verify (draft_k > 0) "
                 "is inseparable from its in-program sampling"
+            )
+        if self.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"serving.role must be mixed|prefill|decode, got {self.role!r}"
+            )
+        if self.role != "mixed" and self.kv_layout != "paged":
+            raise ValueError(
+                f"serving.role={self.role!r} requires kv_layout='paged': "
+                "KV pages are the unit that ships between replicas"
+            )
+        if self.role == "prefill" and self.draft_k:
+            raise ValueError(
+                "serving.role='prefill' replicas never decode; draft_k "
+                "must be 0"
             )
 
 

@@ -360,6 +360,7 @@ def _server(gen: TextGenerator, args) -> None:
         page_pool_tokens=args.page_pool_tokens,
         draft_k=draft_k,
         fused_tail=not args.no_fused_tail,
+        role=args.role,
         obs_dir=args.obs_dir or args.metrics_dir,
         trace=not args.no_trace,
     )
@@ -531,6 +532,15 @@ def main(argv=None) -> None:
                         "(greedy = bit-identical output, sampling = exact "
                         "rejection rule; needs --repetition-penalty 1.0; "
                         "0 = off)")
+    p.add_argument("--role", default=serving_defaults.role,
+                   choices=("mixed", "prefill", "decode"),
+                   help="disaggregated fleet role: 'prefill' runs only "
+                        "chunked prefill and ships finished KV pages to the "
+                        "decode replica each request names (prefill_to); "
+                        "'decode' serves imported streams plus the "
+                        "recompute fallback; 'mixed' (default) is the "
+                        "classic standalone replica. Non-mixed roles "
+                        "require --kv-layout paged")
     p.add_argument("--max-prefill-buckets", type=int,
                    default=serving_defaults.max_prefill_buckets,
                    help="cap on distinct compiled one-shot prefill buckets "

@@ -18,8 +18,10 @@ from zero_transformer_tpu.serving.engine import (
     DONE,
     EXPIRED,
     FAILED,
+    MIGRATED,
     QUEUED,
     REJECTED,
+    ROLES,
     RUNNING,
     Request,
     RequestHandle,
@@ -48,6 +50,7 @@ from zero_transformer_tpu.serving.router import (
     ReplicaRegistry,
     RouterServer,
     chunk_prefix_key,
+    pick_decode_replica,
     pick_replica,
     run_router,
 )
@@ -56,6 +59,8 @@ from zero_transformer_tpu.serving.slots import (
     PagedKVCache,
     PagePool,
     SlotKVCache,
+    page_span_from_wire,
+    page_span_to_wire,
     vectorize_index,
 )
 
@@ -73,6 +78,7 @@ __all__ = [
     "ReplicaRegistry",
     "RouterServer",
     "chunk_prefix_key",
+    "pick_decode_replica",
     "pick_replica",
     "run_router",
     "PagedKVCache",
@@ -86,9 +92,13 @@ __all__ = [
     "DONE",
     "EXPIRED",
     "FAILED",
+    "MIGRATED",
     "QUEUED",
     "REJECTED",
+    "ROLES",
     "RUNNING",
+    "page_span_from_wire",
+    "page_span_to_wire",
     "Request",
     "RequestHandle",
     "ServingEngine",

@@ -160,8 +160,19 @@ CANCELLED = "cancelled"
 EXPIRED = "expired"
 REJECTED = "rejected"
 FAILED = "failed"  # the ENGINE died, not the request
+# the stream now lives on another replica (its pages shipped there); the
+# handle's ``migrated_to`` names the new home — a router attaches there and
+# the client's stream continues with ZERO recomputed tokens
+MIGRATED = "migrated"
 
-_FINISHED = (DONE, CANCELLED, EXPIRED, REJECTED, FAILED)
+_FINISHED = (DONE, CANCELLED, EXPIRED, REJECTED, FAILED, MIGRATED)
+
+# engine roles (disaggregated prefill/decode fleets): a PREFILL replica runs
+# only chunked prefill at max batch and ships every finished stream's pages
+# to the decode replica the request names (``prefill_to``); a DECODE replica
+# serves imported streams (and plain requests, as the recompute fallback);
+# MIXED is the classic single-replica behavior.
+ROLES = ("mixed", "prefill", "decode")
 
 
 @dataclasses.dataclass
@@ -175,6 +186,10 @@ class Request:
     # absolute deadline on the engine's clock (``engine.now()``); None = no
     # deadline. Enforced both in the queue and mid-decode.
     deadline: Optional[float] = None
+    # disaggregation: when set, the finished prefill's pages ship to this
+    # replica URL instead of decoding here (required on prefill-role
+    # engines; honored on mixed engines too)
+    prefill_to: Optional[str] = None
 
 
 class RequestHandle:
@@ -207,6 +222,10 @@ class RequestHandle:
         # Retry-After; invalid requests stay non-retryable 400s
         self.retryable = False
         self.retry_after: Optional[float] = None
+        # terminal status ``migrated``: the replica URL now serving this
+        # stream (the router attaches there and continues the client's SSE
+        # with zero token replay)
+        self.migrated_to: Optional[str] = None
         # how many prompt tokens a prefix-cache hit covered at admission
         # (0 = cold/miss/disabled) — the loadgen splits TTFT by this
         self.prefix_hit_tokens = 0
@@ -712,6 +731,22 @@ def _install_rows(last_logits, gen_mask, rngs, mask, logits_rows, keys):
     )
 
 
+@jax.jit
+def _install_import(last_logits, gen_mask, rngs, veto, slot, row, mask_row,
+                    key, veto_val):
+    """Install ONE imported stream's decode carry (migration receive): the
+    exact last_logits/gen_mask/rng/veto the source exported, at the
+    destination slot — the continuation is bit-identical to the source
+    having kept decoding."""
+    zero = jnp.int32(0)
+    return (
+        jax.lax.dynamic_update_slice(last_logits, row[None], (slot, zero)),
+        jax.lax.dynamic_update_slice(gen_mask, mask_row[None], (slot, zero)),
+        jax.lax.dynamic_update_slice(rngs, key[None], (slot, zero)),
+        jax.lax.dynamic_update_slice(veto, veto_val[None], (slot,)),
+    )
+
+
 class ServingEngine:
     """Slot-scheduled continuous batching over one jitted decode step.
 
@@ -750,6 +785,8 @@ class ServingEngine:
         draft_k: int = 0,
         draft_fn: Optional[Callable[[Sequence[int], int], List[int]]] = None,
         fused_tail: bool = True,
+        role: str = "mixed",
+        page_shipper: Optional[Callable[..., None]] = None,
         obs_dir: Optional[str] = None,
         trace: bool = True,
         trace_capacity: int = 8192,
@@ -798,6 +835,24 @@ class ServingEngine:
                 "path only; speculative verify (draft_k > 0) is inseparable "
                 "from its in-program sampling"
             )
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        if role != "mixed" and kv_layout != "paged":
+            raise ValueError(
+                f"role={role!r} requires kv_layout='paged': KV pages are "
+                "the unit that ships between disaggregated replicas"
+            )
+        if role == "prefill" and draft_k:
+            raise ValueError(
+                "role='prefill' replicas never decode; draft_k must be 0"
+            )
+        self.role = role
+        # the ship seam: callable(payload, target_url, on_done) — provided
+        # by the serving front end (HTTP POST to <target>/ingest off the
+        # tick thread) or a test harness (direct import into a peer
+        # engine). on_done(None) confirms; on_done(err_str) fails the
+        # migration retryably (the source stream falls back to recompute).
+        self.page_shipper = page_shipper
         self.page_size = int(page_size)
         if kv_layout == "paged":
             if self.prefill_chunk == 0:
@@ -901,6 +956,13 @@ class ServingEngine:
         # admission)? classifies the tick's ITL samples for attribution
         self._prefill_work = False
 
+        # disaggregation / migration state (tick thread owns placement;
+        # other threads only enqueue under the lock)
+        self._pending_imports: deque = deque()  # (handle, payload)
+        self._migrate_requests: Dict[str, str] = {}  # rid (or "*") -> target
+        self._migrating: Dict[int, RequestHandle] = {}  # awaiting ship ack
+        self._migrations_in_flight = 0
+
         self._queue: deque = deque()
         self.max_queue = max_queue
         self._lock = threading.Lock()
@@ -972,6 +1034,22 @@ class ServingEngine:
             "spec_ticks": 0,
             "draft_tokens": 0,
             "accepted_tokens": 0,
+            # disaggregation / live migration counters: streams shipped out
+            # (prefill handoffs + live migrations), streams imported, ship
+            # failures (the source stream then fails retryably and the
+            # router falls back to re-dispatch-and-recompute), and prefill
+            # handoffs specifically (the disagg split of migrations_out)
+            "migrations_out": 0,
+            "migrations_in": 0,
+            "migration_failures": 0,
+            "prefill_handoffs": 0,
+            # pinned 0 BY CONSTRUCTION: an imported stream installs its
+            # shipped pages and never runs prefill for consumed positions
+            # (asserted via dest prefill_chunks == 0 in the parity tests).
+            # The O(tokens) cost of the recompute fallback is counted on
+            # the ROUTER (resume_replayed_tokens) — the replica can't
+            # distinguish a resumed-as-prompt request from a long prompt.
+            "import_replayed_tokens": 0,
         }
         # observability (obs/): span tracer, Prometheus registry, flight
         # recorder, on-demand profiler. Latency samples land in FIXED-BUCKET
@@ -1094,6 +1172,13 @@ class ServingEngine:
             and T + request.max_new_tokens > self.cfg.max_seq_len
         ):
             return "learned positions cannot extrapolate past max_seq_len"
+        if request.prefill_to is not None and self.kv_layout != "paged":
+            return "prefill_to requires kv_layout='paged' (pages ship)"
+        if self.role == "prefill" and request.prefill_to is None:
+            return (
+                "this is a prefill-role replica: requests must name a "
+                "decode target (prefill_to)"
+            )
         return None
 
     def submit(
@@ -1104,6 +1189,7 @@ class ServingEngine:
         deadline: Optional[float] = None,
         timeout: Optional[float] = None,
         request_id: Optional[str] = None,
+        prefill_to: Optional[str] = None,
     ) -> RequestHandle:
         """Enqueue a request; returns its handle immediately.
 
@@ -1117,7 +1203,10 @@ class ServingEngine:
         now = self.now()
         if timeout is not None:
             deadline = now + timeout if deadline is None else min(deadline, now + timeout)
-        request = Request(list(prompt), int(max_new_tokens), int(seed), deadline)
+        request = Request(
+            list(prompt), int(max_new_tokens), int(seed), deadline,
+            prefill_to=prefill_to,
+        )
         handle = RequestHandle(request, next(self._ids), now, request_id=request_id)
         handle._tracer = self.tracer
         invalid = self._validate(request)
@@ -1586,10 +1675,83 @@ class ServingEngine:
                 return True
         return False
 
+    # graftlint: hot-path
+    def _handoff_completed(self, ship, last_rows) -> None:
+        """Disaggregation SEND: a finished prefill whose request names a
+        decode target ships its pages + first-token logits there instead of
+        installing into this replica's decode set. The destination installs
+        the exact carry a local install would have (logits row at
+        true_len - 1, PRNGKey(seed), cleared mask/veto), so the handed-off
+        stream is byte-identical to having decoded here — with zero
+        recomputed tokens."""
+        # graftlint: allow[host-sync-in-hot-path] reason=THE designed handoff sync — one device_get of the shipping rows' logits (and seeds' keys), only on prefill-role completions
+        rows = jax.device_get(last_rows)
+        now = self.now()
+        for slot, job in ship:
+            handle = job.handle
+            handle.prefill_done_at = now
+            if handle.admitted_at is not None:
+                self._h_prefill.observe(now - handle.admitted_at)
+            # bank the prefix BEFORE detaching: the banked pages' refcounts
+            # survive the slot release, so the prefill replica's chunk
+            # cache actually accumulates — the whole point of the router's
+            # prefill affinity on a disaggregated fleet
+            self._bank_prefix(slot, handle)
+            try:
+                span = self.slots.export_page_span(
+                    slot, len(handle.request.prompt)
+                )
+            except Exception as exc:  # a bad export fails ONLY this stream, retryably
+                self._detach_slot(slot, True)
+                self._migration_failed(handle, f"export failed: {exc!r}")
+                continue
+            import numpy as _np
+
+            leaves = dict(span["leaves"])
+            leaves["carry/last_logits"] = _np.asarray(
+                rows[slot], _np.float32
+            )
+            leaves["carry/gen_mask"] = _np.zeros(
+                (self.cfg.vocab_size,), _np.bool_
+            )
+            # graftlint: allow[host-sync-in-hot-path] reason=tiny PRNGKey materialization for the wire payload, handoff-only
+            key_host = jax.device_get(jax.random.PRNGKey(handle.request.seed))
+            leaves["carry/rng"] = _np.asarray(key_host, _np.uint32)
+            payload = {
+                **self._stream_meta(
+                    handle, list(handle.request.prompt),
+                    handle.request.max_new_tokens,
+                ),
+                "kind": "decode",
+                "veto": -1,
+                "page_size": span["page_size"],
+                "n_blocks": span["n_blocks"],
+                "n_tokens": span["n_tokens"],
+                "leaves": leaves,
+            }
+            self._detach_slot(slot, True)
+            with self._lock:
+                self._migrating[handle.id] = handle
+                self._migrations_in_flight += 1
+            self._ship(payload, handle.request.prefill_to, handle)
+
     def _install_completed(self, completed, last_rows) -> None:
         """Move slots whose prefill just finished into the decode set (one
         coalesced install), then bank their chunk-aligned prefix spans so
-        the NEXT prompt sharing the prefix skips them."""
+        the NEXT prompt sharing the prefix skips them. Completions whose
+        request names a decode target (``prefill_to``) ship instead."""
+        ship = [
+            (s, j) for s, j in completed
+            if j.handle.request.prefill_to is not None
+        ]
+        if ship:
+            self._handoff_completed(ship, last_rows)
+            completed = [
+                (s, j) for s, j in completed
+                if j.handle.request.prefill_to is None
+            ]
+            if not completed:
+                return
         mask = [False] * self.n_slots
         zero_key = jnp.zeros((2,), jnp.uint32)
         keys = [zero_key] * self.n_slots
@@ -1621,33 +1783,39 @@ class ServingEngine:
             self.stats["peak_occupancy"] = max(
                 self.stats["peak_occupancy"], self.active_count
             )
-            if self._prefix_cache is not None:
-                # store BEFORE the first decode write: positions [0, T) are
-                # all real prompt K/V right now. Slab: one extraction
-                # dispatch covers every chunk-aligned span (the per-chunk
-                # version put n_chunks dispatches on the cold request's
-                # admission->first-token path). Paged: banking is PURE
-                # BOOKKEEPING — the slot's pages get one more reference and
-                # their ids land in the index; no bytes move. Skipped
-                # entirely when the cache already holds the full prefix.
-                prompt = job.handle.request.prompt
-                C = self.prefill_chunk
-                n_chunks = len(prompt) // C
-                if n_chunks and not all(
-                    self._prefix_cache.contains(prompt, j)
-                    for j in range(1, n_chunks + 1)
-                ):
-                    if self.kv_layout == "paged":
-                        bpc = C // self.page_size  # blocks per chunk
-                        pages = self.slots.bank(slot, n_chunks * bpc)
-                        for j in range(1, n_chunks + 1):
-                            self._prefix_cache.store_pages(
-                                prompt, j, pages[(j - 1) * bpc : j * bpc]
-                            )
-                    else:
-                        spans = self.slots.extract_spans(slot, C, n_chunks)
-                        for j, span in enumerate(spans, start=1):
-                            self._prefix_cache.store(prompt, j, span)
+            self._bank_prefix(slot, job.handle)
+
+    def _bank_prefix(self, slot: int, handle: RequestHandle) -> None:
+        """Bank a completed prefill's chunk-aligned prefix spans so the
+        NEXT prompt sharing the prefix skips them. Store BEFORE the first
+        decode write (and before a handoff detaches the slot): positions
+        [0, T) are all real prompt K/V right now. Slab: one extraction
+        dispatch covers every chunk-aligned span. Paged: banking is PURE
+        BOOKKEEPING — the slot's pages get one more reference and their
+        ids land in the index; no bytes move (the reference survives the
+        slot's release, which is what lets prefill-role replicas keep a
+        live chunk cache). Skipped entirely when the cache already holds
+        the full prefix."""
+        if self._prefix_cache is None:
+            return
+        prompt = handle.request.prompt
+        C = self.prefill_chunk
+        n_chunks = len(prompt) // C
+        if n_chunks and not all(
+            self._prefix_cache.contains(prompt, j)
+            for j in range(1, n_chunks + 1)
+        ):
+            if self.kv_layout == "paged":
+                bpc = C // self.page_size  # blocks per chunk
+                pages = self.slots.bank(slot, n_chunks * bpc)
+                for j in range(1, n_chunks + 1):
+                    self._prefix_cache.store_pages(
+                        prompt, j, pages[(j - 1) * bpc : j * bpc]
+                    )
+            else:
+                spans = self.slots.extract_spans(slot, C, n_chunks)
+                for j, span in enumerate(spans, start=1):
+                    self._prefix_cache.store(prompt, j, span)
 
     def _on_prefill_fault(self, exc: Exception) -> None:
         """A chunk-prefill dispatch failed: fail ONLY the slots mid-prefill
@@ -1756,6 +1924,8 @@ class ServingEngine:
         self._swap_pending_params()
         self._sweep_queue()
         self._sweep_active()
+        self._service_migrations()
+        self._service_imports()
         self._prefill_work = False
         self._admit()
         ran_prefill = self._prefill_tick() if self.prefill_chunk else False
@@ -2040,6 +2210,491 @@ class ServingEngine:
         )
         return token, bad
 
+    # ------------------------------------- transferable streams (migration)
+
+    @property
+    def migrations_in_flight(self) -> int:
+        """Streams exported and awaiting the ship acknowledgement."""
+        return self._migrations_in_flight
+
+    def request_migration(self, request_id: str, target: str) -> bool:
+        """Ask the tick thread to migrate the live stream ``request_id`` to
+        ``target`` (a replica base URL). Thread-safe; returns False when no
+        live stream carries that id (the caller maps it to 404) or when
+        this engine has nothing transferable (slab layout — a 202 here
+        would promise a migration that can never be serviced). The export
+        itself happens between ticks — device state stays tick-thread-owned."""
+        if self.kv_layout != "paged":
+            return False
+        # snapshot under the GIL (list() of a dict/list is one C-level op)
+        # — the tick thread mutates both containers concurrently, and bare
+        # iteration from this HTTP thread could see "changed size"
+        active = list(self._active)
+        prefilling = list(self._prefilling.values())
+        found = any(
+            a is not None and a.handle.rid == request_id for a in active
+        ) or any(j.handle.rid == request_id for j in prefilling)
+        if not found:
+            return False
+        with self._lock:
+            self._migrate_requests[request_id] = target
+        return True
+
+    def request_migrate_all(self, target: str) -> int:
+        """Migrate EVERY live stream to ``target`` (scale-down / drain
+        upgrade). Returns how many streams were tagged (0 on a slab
+        engine: pages are the transfer unit, so there is nothing to ship
+        and the caller's classic drain covers it)."""
+        if self.kv_layout != "paged":
+            return 0
+        n = sum(1 for a in list(self._active) if a is not None) + len(
+            self._prefilling
+        )
+        if n:
+            with self._lock:
+                self._migrate_requests["*"] = target
+        return n
+
+    # graftlint: hot-path
+    def _service_migrations(self) -> None:
+        """Tick-thread side of migration SEND: export each tagged slot's
+        pages + decode carry, release the slot, and hand the payload to the
+        shipper. The handle stays unfinished (status ``running``) until the
+        ship acknowledges — success finishes it ``migrated`` (the router
+        attaches at the target, zero tokens replayed), failure finishes it
+        retryably (the router falls back to re-dispatch-and-recompute)."""
+        if self.kv_layout != "paged":
+            return
+        with self._lock:
+            reqs, self._migrate_requests = self._migrate_requests, {}
+        if not reqs:
+            return
+        every = reqs.pop("*", None)
+        jobs: List[tuple] = []  # (slot, handle, is_prefill, target)
+        for slot, act in enumerate(self._active):
+            if act is None:
+                continue
+            target = reqs.get(act.handle.rid, every)
+            if target:
+                jobs.append((slot, act.handle, False, target))
+        for slot, job in list(self._prefilling.items()):
+            target = reqs.get(job.handle.rid, every)
+            if target:
+                jobs.append((slot, job.handle, True, target))
+        for slot, handle, is_prefill, target in jobs:
+            try:
+                if is_prefill:
+                    payload = self._export_prefill(slot)
+                else:
+                    payload = self._export_decoding(slot)
+            except Exception as exc:  # a bad export fails ONLY this stream, retryably
+                self._detach_slot(slot, is_prefill)
+                self._migration_failed(handle, f"export failed: {exc!r}")
+                continue
+            self._detach_slot(slot, is_prefill)
+            with self._lock:
+                self._migrating[handle.id] = handle
+                self._migrations_in_flight += 1
+            self._ship(payload, target, handle)
+
+    def _detach_slot(self, slot: int, is_prefill: bool) -> None:
+        """Free the slot WITHOUT finishing its handle (the handle's fate is
+        the ship's to decide)."""
+        if is_prefill:
+            self._prefilling.pop(slot, None)
+        else:
+            self._active[slot] = None
+        self.slots.release([slot])
+
+    def _stream_meta(self, handle: RequestHandle, consumed: List[int],
+                     remaining: int) -> Dict[str, Any]:
+        req = handle.request
+        deadline_s = (
+            max(0.05, req.deadline - self.now())
+            if req.deadline is not None else None
+        )
+        return {
+            "request_id": handle.rid,
+            "prompt": [int(t) for t in consumed],
+            "max_new_tokens": int(remaining),
+            "seed": int(req.seed),
+            "deadline_s": deadline_s,
+            "draft_k": self.draft_k,
+        }
+
+    # graftlint: hot-path
+    def _export_decoding(self, slot: int) -> Dict[str, Any]:
+        """Payload for a mid-decode stream: pages covering every consumed
+        position [0, prompt + emitted) plus the decode carry (last_logits /
+        gen_mask / rng / veto rows) — the destination continues the exact
+        trajectory with zero recompute."""
+        act = self._active[slot]
+        handle = act.handle
+        consumed = list(handle.request.prompt) + [int(t) for t in handle.tokens]
+        cursor = len(consumed)
+        span = self.slots.export_page_span(slot, cursor)
+        # graftlint: allow[host-sync-in-hot-path] reason=THE designed migration-send sync — one coalesced device_get of the slot's decode carry, only when a stream migrates
+        row, mask_row, key, veto = jax.device_get((
+            self._last_logits[slot], self._gen_mask[slot],
+            self._rngs[slot], self._veto[slot],
+        ))
+        meta = self._stream_meta(
+            handle, consumed,
+            handle.request.max_new_tokens - len(handle.tokens),
+        )
+        leaves = dict(span["leaves"])
+        leaves["carry/last_logits"] = row
+        leaves["carry/gen_mask"] = mask_row
+        leaves["carry/rng"] = key
+        return {
+            **meta,
+            "kind": "decode",
+            "veto": int(veto),
+            "page_size": span["page_size"],
+            "n_blocks": span["n_blocks"],
+            "n_tokens": span["n_tokens"],
+            "leaves": leaves,
+        }
+
+    def _export_prefill(self, slot: int) -> Dict[str, Any]:
+        """Payload for a mid-prefill stream: pages covering [0, fill) and
+        the fill cursor — the destination finishes the remaining chunks
+        (deterministic forward: bit-identical to never having moved)."""
+        job = self._prefilling[slot]
+        span = self.slots.export_page_span(slot, job.fill)
+        meta = self._stream_meta(
+            job.handle, list(job.handle.request.prompt),
+            job.handle.request.max_new_tokens,
+        )
+        return {
+            **meta,
+            "kind": "prefill",
+            "fill": int(job.fill),
+            "page_size": span["page_size"],
+            "n_blocks": span["n_blocks"],
+            "n_tokens": span["n_tokens"],
+            "leaves": dict(span["leaves"]),
+        }
+
+    def _ship(self, payload: Dict[str, Any], target: str,
+              handle: RequestHandle) -> None:
+        shipper = self.page_shipper
+        if shipper is None:
+            self._migration_failed(handle, "no page shipper configured")
+            return
+
+        def on_done(err: Optional[str]) -> None:
+            if err is None:
+                self._migration_done(handle, target)
+            else:
+                self._migration_failed(handle, err)
+
+        try:
+            shipper(payload, target, on_done)
+        except Exception as exc:  # a shipper crash degrades to the recompute fallback
+            self._migration_failed(handle, f"shipper raised: {exc!r}")
+
+    def _migration_done(self, handle: RequestHandle, target: str) -> None:
+        # runs on the SHIPPER's thread: every read-modify-write here races
+        # the tick thread's increments, so all bookkeeping sits under the
+        # engine lock (the gauge feeds the router's placement — drift
+        # would be permanent)
+        with self._lock:
+            self._migrating.pop(handle.id, None)
+            self._migrations_in_flight = max(0, self._migrations_in_flight - 1)
+            if handle.status in _FINISHED:
+                return  # an abort beat the ship ack; the client already heard
+            handle.migrated_to = target
+            self.stats["migrations_out"] += 1
+            if handle.request.prefill_to is not None:
+                self.stats["prefill_handoffs"] += 1
+        handle._finish(MIGRATED, self.now())
+        self._event(
+            "stream_migrated", target=target, request_id=handle.rid,
+            tokens_done=len(handle.tokens),
+        )
+
+    def _migration_failed(self, handle: RequestHandle, err: str) -> None:
+        with self._lock:
+            self._migrating.pop(handle.id, None)
+            self._migrations_in_flight = max(
+                0, self._migrations_in_flight - 1
+            )
+            finished = handle.status in _FINISHED
+            if not finished:
+                self.stats["migration_failures"] += 1
+        if finished:
+            return  # an abort beat the ship ack
+        self._event("migration_failed", error=err, request_id=handle.rid)
+        # post-mortem window: a failed ship is exactly when an operator
+        # asks "what was the fleet doing" — dump while the ring still
+        # holds the ticks around the export
+        self.flight.dump(
+            "migration_failed",
+            extra={"error": err, "request_id": handle.rid},
+        )
+        handle._finish(
+            FAILED, self.now(),
+            error=f"migration failed: {err} (retryable)", retryable=True,
+        )
+
+    # ---- receive side ----------------------------------------------------
+
+    @staticmethod
+    def _validate_import_payload(payload) -> Optional[str]:
+        """Structural check of a migrated-stream payload — everything the
+        tick thread will later subscript must exist and parse, so a bad
+        peer costs one rejected import, not the scheduler thread."""
+        if not isinstance(payload, dict):
+            return "payload must be a dict"
+        for key in ("kind", "prompt", "max_new_tokens", "page_size",
+                    "n_blocks", "leaves"):
+            if key not in payload:
+                return f"missing field {key!r}"
+        if payload["kind"] not in ("decode", "prefill"):
+            return f"unknown kind {payload['kind']!r}"
+        if not isinstance(payload["leaves"], dict):
+            return "leaves must be a dict"
+        try:
+            int(payload["max_new_tokens"])
+            int(payload["page_size"])
+            int(payload["n_blocks"])
+            int(payload.get("veto", -1))
+            [int(t) for t in payload["prompt"]]
+            if payload.get("deadline_s") is not None:
+                float(payload["deadline_s"])
+            if payload["kind"] == "prefill":
+                int(payload["fill"])
+        except (TypeError, ValueError, KeyError) as exc:
+            return f"unparseable field: {exc!r}"
+        if payload["kind"] == "decode":
+            for leaf in ("carry/last_logits", "carry/gen_mask", "carry/rng"):
+                if leaf not in payload["leaves"]:
+                    return f"missing decode carry leaf {leaf!r}"
+        return None
+
+    def import_stream(self, payload: Dict[str, Any]) -> RequestHandle:
+        """Accept a migrated stream (any thread): validate, then queue it
+        for the tick thread to place — device state stays tick-owned. The
+        returned handle streams the CONTINUATION (only new tokens; the
+        client already holds the rest). A handle that could not be accepted
+        comes back already finished (rejected/failed, retryable where the
+        condition is transient)."""
+        now = self.now()
+        # structural validation FIRST: a version-skewed or malformed peer
+        # payload must become a clean retryable rejection here, never a
+        # KeyError on the tick thread (which would abort the whole engine)
+        structural = self._validate_import_payload(payload)
+        if structural is not None:
+            handle = RequestHandle(
+                Request([0], 1), next(self._ids), now,
+                request_id=payload.get("request_id")
+                if isinstance(payload, dict) else None,
+            )
+            handle._tracer = self.tracer
+            handle._finish(
+                REJECTED, now, error=f"bad import payload: {structural}",
+                retryable=True,
+            )
+            return handle
+        deadline = (
+            now + float(payload["deadline_s"])
+            if payload.get("deadline_s") is not None else None
+        )
+        request = Request(
+            [int(t) for t in payload["prompt"]],
+            int(payload["max_new_tokens"]),
+            int(payload.get("seed", 0)),
+            deadline,
+        )
+        handle = RequestHandle(
+            request, next(self._ids), now,
+            request_id=payload.get("request_id"),
+        )
+        handle._tracer = self.tracer
+        if self.role == "prefill":
+            handle._finish(
+                REJECTED, now,
+                error="prefill-role replica cannot import streams",
+            )
+            return handle
+        if self.kv_layout != "paged":
+            handle._finish(
+                REJECTED, now, error="import requires kv_layout='paged'",
+            )
+            return handle
+        if int(payload.get("draft_k", 0)) != self.draft_k:
+            # the veto/rewind carry is draft_k-shaped; a mismatched fleet
+            # config must degrade to the recompute fallback, not corrupt
+            handle._finish(
+                REJECTED, now,
+                error=(
+                    f"draft_k mismatch: stream {payload.get('draft_k')}, "
+                    f"replica {self.draft_k}"
+                ),
+                retryable=True,
+            )
+            return handle
+        invalid = self._validate(request)
+        if invalid is not None:
+            handle._finish(REJECTED, now, error=invalid)
+            return handle
+        with self._lock:
+            if self._dead is not None:
+                handle._finish(FAILED, now, error=self._dead)
+                return handle
+            if self.lifecycle.state == DRAINING:
+                handle._finish(
+                    REJECTED, now, error="server draining; retry elsewhere",
+                    retryable=True, retry_after=1.0,
+                )
+                return handle
+            if len(self._pending_imports) >= self.max_queue:
+                # each queued import pins a whole deserialized span in host
+                # memory — the same backpressure bound as submit(), so a
+                # fleet-wide migrate_all onto one target gets honest 503s
+                # (shippers fail over) instead of ballooning this replica
+                handle._finish(
+                    REJECTED, now,
+                    error=f"import queue full ({self.max_queue} waiting)",
+                    retryable=True, retry_after=1.0,
+                )
+                return handle
+            self._pending_imports.append((handle, payload))
+        return handle
+
+    # graftlint: hot-path
+    def _service_imports(self) -> None:
+        """Tick-thread side of migration RECEIVE: place queued imports —
+        allocate pages, scatter the span in, install the decode carry (or
+        re-arm the prefill job), and continue. Imports outrank normal
+        admission (their tokens are already paid for elsewhere); one that
+        cannot fit yet waits at the head, FIFO, exactly like paged
+        admission backpressure. Entries are POPPED under the lock (never
+        peeked): a concurrent ``begin_drain`` snapshot can therefore never
+        hold the same handle this thread is placing — the requeue path
+        re-checks drain state under the same lock, so a drained handle is
+        finished exactly once, by exactly one side."""
+        while True:
+            with self._lock:
+                if not self._pending_imports:
+                    return
+                handle, payload = self._pending_imports.popleft()
+            now = self.now()
+            if handle.status in _FINISHED:
+                continue  # an abort beat us to it; nothing to place
+            if handle._cancel.is_set():
+                self.stats["cancelled"] += 1
+                handle._finish(CANCELLED, now)
+                continue
+            if (
+                handle.request.deadline is not None
+                and now > handle.request.deadline
+            ):
+                self.stats["expired_queued"] += 1
+                handle._finish(
+                    EXPIRED, now, error="deadline expired awaiting import"
+                )
+                continue
+            wait = not self.slots.free_count
+            if not wait:
+                total_blocks = self.slots.blocks_for(
+                    self._total_need_tokens(handle.request)
+                )
+                short = total_blocks - self.slots.pool.available
+                if short > 0 and self._prefix_cache is not None and len(
+                    self._prefix_cache
+                ):
+                    self.stats["page_faults"] += 1
+                    self.stats["pages_reclaimed"] += self._prefix_cache.reclaim(
+                        short
+                    )
+                wait = total_blocks > self.slots.pool.available
+            if not wait and self._place_import(handle, payload):
+                continue
+            # cannot place yet (no slot / pool pressure / pool raced away):
+            # back to the HEAD — unless a drain/abort landed meanwhile, in
+            # which case the queue we'd rejoin has already been flushed
+            with self._lock:
+                if self._dead is None and self.lifecycle.state != DRAINING:
+                    self._pending_imports.appendleft((handle, payload))
+                    return
+            handle._finish(
+                REJECTED, now, error="server draining; retry elsewhere",
+                retryable=True, retry_after=1.0,
+            )
+            return
+
+    # graftlint: hot-path
+    def _place_import(self, handle: RequestHandle, payload: Dict[str, Any]) -> bool:
+        """Materialize one import into a slot. True when the handle left
+        the pending queue (placed OR terminally failed); False to retry
+        next tick."""
+        slot = self.slots.acquire()
+        now = self.now()
+        # graftlint: allow[host-sync-in-hot-path] reason=wire-payload fields are host ints/numpy (json header + frombuffer), never device values
+        fill, veto_val, n_blocks = int(payload.get("fill", 0)), int(payload.get("veto", -1)), int(payload["n_blocks"])
+        try:
+            ok = self.slots.import_page_span(slot, {
+                "page_size": payload["page_size"],
+                "n_blocks": n_blocks,
+                "leaves": {
+                    k: v for k, v in payload["leaves"].items()
+                    if not k.startswith("carry/")
+                },
+            })
+        except Exception as exc:  # geometry/dtype skew fails ONE import, never the tick thread
+            self.slots.release([slot])
+            handle._finish(
+                FAILED, now, error=f"import rejected: {exc}", retryable=True,
+            )
+            return True
+        if not ok:
+            self.slots.release([slot])
+            return False  # pool raced away; retry next tick
+        try:
+            self.slots.reserve(slot, self._total_need_tokens(handle.request))
+            handle.status = RUNNING
+            handle.admitted_at = now
+            self._h_queue_wait.observe(now - handle.submitted_at)
+            if payload["kind"] == "prefill":
+                self.slots.set_cursor(slot, fill)
+                self._prefilling[slot] = _PrefillJob(handle, fill=fill)
+            else:
+                leaves = payload["leaves"]
+                self.slots.set_cursor(slot, len(handle.request.prompt))
+                args = (
+                    self._last_logits, self._gen_mask, self._rngs,
+                    self._veto, jnp.int32(slot),
+                    jnp.asarray(leaves["carry/last_logits"], jnp.float32),
+                    jnp.asarray(leaves["carry/gen_mask"], jnp.bool_),
+                    jnp.asarray(leaves["carry/rng"], jnp.uint32),
+                    jnp.int32(veto_val),
+                )
+                self._last_logits, self._gen_mask, self._rngs, self._veto = _in_mesh(
+                    self.mesh, _install_import, *args
+                )
+                handle.prefill_done_at = now
+                self._active[slot] = _ActiveSlot(handle)
+                self.stats["peak_occupancy"] = max(
+                    self.stats["peak_occupancy"], self.active_count
+                )
+        except Exception as exc:  # bad carry shapes fail ONE import, never the tick thread
+            self._prefilling.pop(slot, None)
+            self._active[slot] = None
+            self.slots.release([slot])
+            handle._finish(
+                FAILED, now, error=f"import install failed: {exc!r}",
+                retryable=True,
+            )
+            return True
+        self.stats["migrations_in"] += 1
+        self._event(
+            "stream_imported", request_id=handle.rid, kind=payload["kind"],
+            blocks=n_blocks,
+        )
+        return True
+
     def _grow_decode_pages(self) -> None:
         """Paged: extend each decoding slot's block table to cover this
         tick's writes (cursor + 1, plus the draft window when speculating),
@@ -2205,6 +2860,10 @@ class ServingEngine:
                 now + deadline_s if deadline_s is not None else None
             )
             queued, self._queue = list(self._queue), deque()
+            pending, self._pending_imports = (
+                list(self._pending_imports), deque()
+            )
+        queued = queued + [h for h, _ in pending]
         for handle in queued:
             self.stats["rejected_draining"] += 1
             handle._finish(
@@ -2227,6 +2886,8 @@ class ServingEngine:
             self.active_count == 0
             and not self._prefilling
             and self.queue_depth == 0
+            and not self._migrating
+            and not self._pending_imports
         ):
             self._finish_drain(forced=0)
             return True
@@ -2417,6 +3078,16 @@ class ServingEngine:
                 self._active[slot] = None
         for slot in sorted(self._prefilling):
             self._prefilling.pop(slot).handle._finish(FAILED, now, error=reason)
+        with self._lock:
+            migrating = list(self._migrating.values())
+            self._migrating.clear()
+            pending, self._pending_imports = (
+                list(self._pending_imports), deque()
+            )
+        for handle in migrating:
+            handle._finish(FAILED, now, error=reason, retryable=True)
+        for handle, _ in pending:
+            handle._finish(FAILED, now, error=reason, retryable=True)
         self._profiler.abort()
         if "drained" not in reason:
             # a drain already dumped through _finish_drain; every OTHER path
@@ -2475,6 +3146,11 @@ class ServingEngine:
             # control's split dispatches)?
             "kernel_paged_attention": int(self._paged_kernel),
             "fused_tail": int(self.fused_tail),
+            # disaggregation / migration gauges
+            "role": self.role,
+            "free_pages": self.free_pages,
+            "migrations_in_flight": self._migrations_in_flight,
+            "pending_imports": len(self._pending_imports),
         }
         # compile-family sanitizer gauges: distinct jit signatures seen per
         # labeled dispatch site vs its declared bound; a nonzero violation
@@ -2512,6 +3188,8 @@ class ServingEngine:
             "expired_prefilling",
             "page_faults", "pages_reclaimed", "preemptions",
             "spec_ticks", "draft_tokens", "accepted_tokens",
+            "migrations_out", "migrations_in", "migration_failures",
+            "prefill_handoffs", "import_replayed_tokens",
         ):
             snap[k] = self.stats[k]
         return snap
@@ -2554,6 +3232,12 @@ class ServingEngine:
             ("spec_ticks", "Speculative decode ticks"),
             ("draft_tokens", "Draft tokens proposed"),
             ("accepted_tokens", "Draft tokens accepted by verify"),
+            ("migrations_out", "Streams shipped to another replica"),
+            ("migrations_in", "Migrated streams imported and continued"),
+            ("migration_failures", "Ship failures (fell back to recompute)"),
+            ("prefill_handoffs", "Disaggregated prefill-to-decode handoffs"),
+            ("import_replayed_tokens",
+             "Tokens recomputed by imported streams (0 by construction)"),
         ):
             reg.counter_func(
                 f"serve_{key}", help_text,
@@ -2595,6 +3279,30 @@ class ServingEngine:
             lambda: (
                 self.slots.page_pool_util if self.kv_layout == "paged" else 0.0
             ),
+        )
+        # page-pool pressure as first-class scrape families (pre-PR12 a
+        # router could only see free_pages by polling /healthz)
+        reg.gauge_func(
+            "serve_free_pages",
+            "Spare KV capacity (free pool pages, or free slots when slab)",
+            lambda: self.free_pages,
+        )
+        reg.counter_func(
+            "serve_cow_copies",
+            "Copy-on-write page copies (shared page written post-import/share)",
+            lambda: (
+                self.slots.cow_copies if self.kv_layout == "paged" else 0
+            ),
+        )
+        reg.gauge_func(
+            "serve_migrations_in_flight",
+            "Streams exported and awaiting their ship acknowledgement",
+            lambda: self._migrations_in_flight,
+        )
+        reg.gauge_func(
+            "serve_pending_imports",
+            "Imported streams awaiting placement into a slot",
+            lambda: len(self._pending_imports),
         )
         reg.gauge_func(
             "serve_prefix_cache_entries", "Prefix-cache entries resident",

@@ -126,6 +126,24 @@ class Replica:
     active_slots: int = 0
     free_pages: int = 0
     breaker_open: bool = False
+    # disaggregation: the replica's engine role (prefill/decode/mixed) and
+    # its in-flight page shipments, both scraped from /healthz — plus the
+    # page-pool pressure stats the router mirrors as per-replica gauges
+    role: str = "mixed"
+    migrations_in_flight: int = 0
+    page_faults: int = 0
+    cow_copies: int = 0
+    # importability: pages can only ship to a paged-layout engine; "" until
+    # the first successful probe (treated as NOT importable — never ship
+    # into the unknown). ``draft_k`` rides along because an import's
+    # veto/rewind carry is draft_k-shaped — a mismatched target rejects
+    # every ship, so placement filters on it up front.
+    kv_layout: str = ""
+    draft_k: int = 0
+
+    @property
+    def importable(self) -> bool:
+        return self.kv_layout == "paged"
     # router-side live view (fresher than the last probe)
     active_relays: int = 0
     tokens_relayed: int = 0
@@ -179,6 +197,7 @@ class ReplicaRegistry:
             raise ValueError("eject_threshold must be >= 1")
         self.clock = clock
         self.probe_interval = probe_interval
+        self.eject_threshold = eject_threshold
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self._lock = threading.Lock()
@@ -212,7 +231,9 @@ class ReplicaRegistry:
         now = self.clock()
         events: List[Tuple[str, str]] = []
         with self._lock:
-            r = self.replicas[rid]
+            r = self.replicas.get(rid)
+            if r is None:
+                return events  # removed (autoscale retire) mid-probe
             r.last_probe_at = now
             if ok:
                 state = str((body or {}).get("state", ""))
@@ -242,6 +263,14 @@ class ReplicaRegistry:
                     )
                     r.free_pages = int(body.get("free_pages", 0) or 0)
                     r.breaker_open = bool(body.get("breaker_open", False))
+                    r.role = str(body.get("role", "mixed") or "mixed")
+                    r.migrations_in_flight = int(
+                        body.get("migrations_in_flight", 0) or 0
+                    )
+                    r.page_faults = int(body.get("page_faults", 0) or 0)
+                    r.cow_copies = int(body.get("cow_copies", 0) or 0)
+                    r.kv_layout = str(body.get("kv_layout", "") or "")
+                    r.draft_k = int(body.get("draft_k", 0) or 0)
                 r.next_probe_at = now + self.probe_interval
             else:
                 r.consecutive_failures += 1
@@ -266,8 +295,8 @@ class ReplicaRegistry:
         re-probe so the registry converges faster than the probe interval."""
         events = self.observe_probe(rid, ok=False)
         with self._lock:
-            r = self.replicas[rid]
-            if r.state != EJECTED:
+            r = self.replicas.get(rid)
+            if r is not None and r.state != EJECTED:
                 r.next_probe_at = self.clock()  # probe now, not next tick
         return events
 
@@ -287,29 +316,59 @@ class ReplicaRegistry:
     def get(self, rid: str) -> Replica:
         return self.replicas[rid]
 
+    # ------------------------------------------------------- fleet elasticity
+
+    def add(self, url: str) -> str:
+        """Register a new replica (autoscale spawn): it enters UNKNOWN and
+        joins rotation on its first clean READY probe. Returns its id."""
+        rid, host, port = _parse_url(url)
+        with self._lock:
+            if rid in self.replicas:
+                return rid
+            self.replicas[rid] = Replica(
+                id=rid, url=url, host=host, port=port,
+                breaker=CircuitBreaker(
+                    threshold=self.eject_threshold, cooldown=1
+                ),
+            )
+        return rid
+
+    def remove(self, rid: str) -> None:
+        """Forget a replica (autoscale retire). The caller owns cordoning
+        and draining/migrating first — removal is pure bookkeeping."""
+        with self._lock:
+            self.replicas.pop(rid, None)
+
     # -------------------------------------------------- router-side bookkeeping
 
     def cordon(self, rid: str) -> None:
         with self._lock:
-            self.replicas[rid].cordoned = True
+            if rid in self.replicas:
+                self.replicas[rid].cordoned = True
 
     def uncordon(self, rid: str) -> None:
         with self._lock:
-            self.replicas[rid].cordoned = False
+            if rid in self.replicas:
+                self.replicas[rid].cordoned = False
 
     def inc_relay(self, rid: str) -> None:
         with self._lock:
-            r = self.replicas[rid]
-            r.active_relays += 1
-            r.requests_routed += 1
+            r = self.replicas.get(rid)
+            if r is not None:
+                r.active_relays += 1
+                r.requests_routed += 1
 
     def dec_relay(self, rid: str) -> None:
         with self._lock:
-            self.replicas[rid].active_relays -= 1
+            r = self.replicas.get(rid)
+            if r is not None:
+                r.active_relays -= 1
 
     def add_tokens(self, rid: str, n: int) -> None:
         with self._lock:
-            self.replicas[rid].tokens_relayed += n
+            r = self.replicas.get(rid)
+            if r is not None:
+                r.tokens_relayed += n
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -325,6 +384,11 @@ class ReplicaRegistry:
                     "queue_depth": r.queue_depth,
                     "active_slots": r.active_slots,
                     "free_pages": r.free_pages,
+                    "role": r.role,
+                    "kv_layout": r.kv_layout,
+                    "migrations_in_flight": r.migrations_in_flight,
+                    "page_faults": r.page_faults,
+                    "cow_copies": r.cow_copies,
                     "active_relays": r.active_relays,
                     "tokens_relayed": r.tokens_relayed,
                     "requests_routed": r.requests_routed,
@@ -451,6 +515,21 @@ def pick_replica(
     return min(pool, key=Replica.load_score)
 
 
+def pick_decode_replica(candidates: Sequence[Replica]) -> Optional[Replica]:
+    """Decode PLACEMENT for a disaggregated handoff, pure: most free KV
+    pages first (the pages are about to land there), then lowest measured
+    ITL EWMA (the stream lives out its decode at that pace), then the
+    least-loaded tie-break. READY beats DEGRADED as everywhere else."""
+    ready = [c for c in candidates if c.state == READY]
+    pool = ready or [c for c in candidates if c.state == DEGRADED]
+    if not pool:
+        return None
+    return min(
+        pool,
+        key=lambda c: (-c.free_pages, c.itl_ewma_ms, c.load_score()),
+    )
+
+
 # ------------------------------------------------------------------- server
 
 
@@ -497,6 +576,18 @@ class RouterServer:
         trace: bool = True,
         trace_capacity: int = 8192,
         clock=time.monotonic,
+        disaggregate: str = "auto",
+        migrate_drain: bool = True,
+        scaler=None,
+        autoscale_interval: float = 0.0,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        scale_up_queue: float = 4.0,
+        scale_up_itl_ms: float = 0.0,
+        scale_up_free_pages: int = 0,
+        scale_down_active: int = 0,
+        scale_patience: int = 3,
+        scale_drain_timeout_s: float = 15.0,
     ):
         self.clock = clock
         self.probe_timeout = probe_timeout
@@ -506,6 +597,34 @@ class RouterServer:
         self.stream_timeout = stream_timeout
         self.max_body_bytes = max_body_bytes
         self.admin_token = admin_token
+        # disaggregated prefill/decode dispatch: "auto" engages whenever the
+        # fleet advertises at least one prefill-role AND one decode-capable
+        # replica on /healthz; "off" forces the classic single-replica path
+        if disaggregate not in ("auto", "off"):
+            raise ValueError("disaggregate must be auto|off")
+        self.disaggregate = disaggregate
+        # drain-as-migrate: rolling reload and autoscale retire ask the
+        # replica to SHIP its live streams (zero-recompute handoff) instead
+        # of waiting out every in-flight generation; the recompute resume
+        # stays as the fallback when the source can't comply
+        self.migrate_drain = bool(migrate_drain)
+        # autoscaler: a control loop over the load signals every probe
+        # already scrapes (queue depth, ITL EWMA, free_pages), acting
+        # through ``scaler`` — an object with ``spawn() -> url`` and
+        # ``retire(url)`` — and the same cordon/drain machinery the rolling
+        # reload rides. Off unless both an interval and a scaler are given.
+        self.scaler = scaler
+        self.autoscale_interval = float(autoscale_interval)
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.scale_up_queue = float(scale_up_queue)
+        self.scale_up_itl_ms = float(scale_up_itl_ms)
+        self.scale_up_free_pages = int(scale_up_free_pages)
+        self.scale_down_active = int(scale_down_active)
+        self.scale_patience = max(1, int(scale_patience))
+        self.scale_drain_timeout_s = float(scale_drain_timeout_s)
+        self._hot_ticks = 0
+        self._idle_ticks = 0
         self.registry = ReplicaRegistry(
             replicas, clock=clock, probe_interval=probe_interval,
             eject_threshold=eject_threshold, backoff_base_s=backoff_base_s,
@@ -535,6 +654,18 @@ class RouterServer:
             "rolling_reloads": 0,
             "reload_steps": 0,
             "reload_failures": 0,
+            # disaggregation / migration / autoscale counters
+            "disagg_dispatches": 0,
+            "disagg_fallbacks": 0,
+            "migration_resumes": 0,
+            "migrations_requested": 0,
+            # tokens the RECOMPUTE fallback re-sent as prompt on a resume
+            # hop (an attach resume adds 0 — the zero-replay proof pins
+            # this counter)
+            "resume_replayed_tokens": 0,
+            "autoscale_ups": 0,
+            "autoscale_downs": 0,
+            "autoscale_aborts": 0,
         }
         # handler threads bump stats concurrently; += on a dict entry is a
         # read-modify-write, so every increment goes through _bump
@@ -550,6 +681,9 @@ class RouterServer:
         self._reload_busy = threading.Lock()
         self._probe_thread = threading.Thread(
             target=self._probe_loop, name="router-probe", daemon=True
+        )
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop, name="router-autoscale", daemon=True
         )
         outer = self
 
@@ -643,6 +777,8 @@ class RouterServer:
     def start(self, probe: bool = True) -> None:
         if probe and not self._probe_thread.ident:
             self._probe_thread.start()
+        if self._autoscale_enabled() and not self._autoscale_thread.ident:
+            self._autoscale_thread.start()
         self._server_thread = threading.Thread(
             target=self._httpd.serve_forever, name="router-http", daemon=True
         )
@@ -651,6 +787,8 @@ class RouterServer:
     def serve_forever(self) -> None:
         if not self._probe_thread.ident:
             self._probe_thread.start()
+        if self._autoscale_enabled() and not self._autoscale_thread.ident:
+            self._autoscale_thread.start()
         try:
             self._httpd.serve_forever()
         finally:
@@ -728,8 +866,12 @@ class RouterServer:
     def _route(
         self, tokens: Optional[Sequence[int]], exclude: Set[str]
     ) -> Optional[Replica]:
+        # prefill-role replicas never take a whole request (their engine
+        # rejects anything without a decode target) — the classic path and
+        # the recompute fallback route only to decode-capable replicas
         candidates = [
-            r for r in self.registry.routable() if r.id not in exclude
+            r for r in self.registry.routable()
+            if r.id not in exclude and r.role != "prefill"
         ]
         chunk = self.affinity.chunk_tokens
         affine = tokens is not None and chunk >= 1 and len(tokens) >= chunk
@@ -744,6 +886,140 @@ class RouterServer:
                 self.affinity.record(tokens, rep.id)
             self._bump("routed")
         return rep
+
+    # ------------------------------------------- disaggregated dispatch
+
+    def _disagg_enabled(self) -> bool:
+        """True when the fleet can split a request by phase: at least one
+        prefill-role replica AND one decode-capable one in rotation."""
+        if self.disaggregate == "off":
+            return False
+        reps = self.registry.routable()
+        return any(r.role == "prefill" for r in reps) and any(
+            r.role != "prefill" for r in reps
+        )
+
+    def _plan_disagg(
+        self, tokens: Optional[Sequence[int]]
+    ) -> Optional[Tuple[Replica, Replica]]:
+        """(prefill replica, decode replica) for a fresh request: admission
+        is prefix-affine WITHIN the prefill pool (its chunk cache is what
+        affinity is for); decode placement goes where the pages fit best —
+        most free_pages, then lowest ITL EWMA (both scraped on /healthz)."""
+        reps = self.registry.routable()
+        prefills = [r for r in reps if r.role == "prefill"]
+        # pages can only land on a paged-layout engine with a MATCHING
+        # draft_k (prefill replicas never speculate, so their handoffs
+        # carry draft_k 0): a slab or speculative replica in the fleet
+        # must not silently turn every handoff into a failed ship +
+        # recompute fallback
+        decodes = [
+            r for r in reps
+            if r.role != "prefill" and r.importable and r.draft_k == 0
+        ]
+        if not prefills or not decodes:
+            return None
+        aff = self.affinity.lookup(tokens) if tokens is not None else None
+        P = pick_replica(prefills, aff)
+        D = pick_decode_replica(decodes)
+        if P is None or D is None:
+            return None
+        return P, D
+
+    def _replica_for_url(self, url: str) -> Replica:
+        """The registry's replica for a ``migrated_to`` URL, or an ad-hoc
+        row when the target is outside the registry (still relayed — the
+        page shipper trusted it, so the attach must follow the pages)."""
+        rid, host, port = _parse_url(url)
+        rep = self.registry.replicas.get(rid)
+        if rep is None:
+            rep = Replica(id=rid, url=url, host=host, port=port, state=READY)
+        return rep
+
+    def _disagg_dispatch(
+        self, P: Replica, D: Replica, req: dict, rid: str, state: dict,
+    ) -> Tuple[bool, str]:
+        """Phase 1 of the split request: a prefill-only JSON dispatch to
+        ``P`` naming ``D`` as the page target. On success the stream's next
+        hop is an ATTACH at the decode replica (``state['attach']``); any
+        failure degrades to the classic path (False, reason)."""
+        body = dict(req)
+        body.pop("request_id", None)
+        body["stream"] = False
+        body["prefill_to"] = (
+            D.url if "//" in D.url else f"http://{D.url}"
+        )
+        self.registry.inc_relay(P.id)
+        hop0 = self.clock()
+        status: Optional[int] = None
+        try:
+            status, doc = self._post_replica(P, "/generate", body, rid=rid)
+        except (OSError, http.client.HTTPException) as exc:
+            self._registry_events(
+                self.registry.observe_relay_failure(P.id, str(exc))
+            )
+            return False, f"prefill replica {P.id} failed: {exc}"
+        finally:
+            self.registry.dec_relay(P.id)
+            self.tracer.add("relay", rid, hop0, self.clock(), {
+                "replica": P.id, "mode": "prefill",
+                "status": status if status is not None else "dead",
+            })
+        if status == 200 and doc.get("status") == "migrated" and doc.get(
+            "migrated_to"
+        ):
+            if req.get("tokens") is not None:
+                # prefill affinity: the NEXT prompt sharing this prefix
+                # should land on the same prefill replica's chunk cache
+                self.affinity.record(req["tokens"], P.id)
+            state["attach"] = str(doc["migrated_to"])
+            self._bump("disagg_dispatches")
+            self._bump("routed")
+            return True, ""
+        return False, (
+            f"prefill dispatch to {P.id} returned {status}: "
+            f"{doc.get('error', doc.get('status', ''))}"
+        )
+
+    def _attach_collect(
+        self, url: str, rid: str
+    ) -> Tuple[List[int], Optional[dict]]:
+        """Attach to an imported stream and collect it wholesale (the JSON
+        non-stream path's tail of a migrated request)."""
+        rep = self._replica_for_url(url)
+        conn = None
+        try:
+            conn = self._connect(rep)
+            conn.request(
+                "POST", "/attach", json.dumps({"request_id": rid}),
+                {"Content-Type": "application/json", "X-Request-Id": rid},
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return [], None
+            ids: List[int] = []
+            texts: List[str] = []
+            while True:
+                line = resp.readline()
+                if not line:
+                    return ids, None
+                if not line.startswith(b"data: "):
+                    continue
+                event = json.loads(line[6:])
+                if event.get("done"):
+                    event["text"] = "".join(texts) if texts else event.get(
+                        "text", ""
+                    )
+                    return ids, event
+                if "token" in event:
+                    ids.append(int(event["token"]))
+                if event.get("text"):
+                    texts.append(str(event["text"]))
+        except (OSError, ValueError, http.client.HTTPException):
+            return [], None
+        finally:
+            if conn is not None:
+                conn.close()
 
     # ---------------------------------------------------------------- health
 
@@ -804,6 +1080,15 @@ class RouterServer:
             ("rolling_reloads", "Rolling fleet reloads started"),
             ("reload_steps", "Per-replica rolling-reload steps completed"),
             ("reload_failures", "Per-replica rolling-reload steps failed"),
+            ("disagg_dispatches", "Requests split prefill/decode by phase"),
+            ("disagg_fallbacks", "Disagg dispatches degraded to the classic path"),
+            ("migration_resumes", "Streams attach-resumed after a migration"),
+            ("migrations_requested", "Streams asked to migrate (drain/retire)"),
+            ("resume_replayed_tokens",
+             "Tokens re-sent as prompt by the recompute fallback (attach adds 0)"),
+            ("autoscale_ups", "Replicas spawned by the autoscaler"),
+            ("autoscale_downs", "Replicas retired by the autoscaler"),
+            ("autoscale_aborts", "Scale-downs aborted over undrainable streams"),
         ):
             reg.counter_func(
                 f"router_{key}", help_text, (lambda k=key: self.stats[k])
@@ -850,6 +1135,39 @@ class RouterServer:
             "router_replica_tokens_relayed", "Tokens relayed per replica",
             lambda: [
                 ({"replica": rid}, info["tokens_relayed"])
+                for rid, info in fleet().items()
+            ],
+        )
+        # engine page-pool stats mirrored fleet-wide (pre-PR12 free_pages
+        # was a poll-only /healthz field; now every scrape of the router
+        # shows per-replica KV pressure and migration load)
+        reg.gauge_func(
+            "router_replica_free_pages", "Scraped per-replica free KV pages",
+            lambda: [
+                ({"replica": rid}, info["free_pages"])
+                for rid, info in fleet().items()
+            ],
+        )
+        reg.counter_func(
+            "router_replica_page_faults", "Scraped per-replica page faults",
+            lambda: [
+                ({"replica": rid}, info["page_faults"])
+                for rid, info in fleet().items()
+            ],
+        )
+        reg.counter_func(
+            "router_replica_cow_copies",
+            "Scraped per-replica copy-on-write page copies",
+            lambda: [
+                ({"replica": rid}, info["cow_copies"])
+                for rid, info in fleet().items()
+            ],
+        )
+        reg.gauge_func(
+            "router_replica_migrations_in_flight",
+            "Scraped per-replica in-flight page shipments",
+            lambda: [
+                ({"replica": rid}, info["migrations_in_flight"])
                 for rid, info in fleet().items()
             ],
         )
@@ -1003,6 +1321,27 @@ class RouterServer:
                 self._bump("failovers")
                 last_error = str(doc.get("error", "replica engine failure"))
                 continue
+            if status == 200 and doc.get("status") == "migrated" and doc.get(
+                "migrated_to"
+            ):
+                # the stream moved mid-request (drain-as-migrate or a
+                # disaggregated handoff): collect the continuation at its
+                # new home — zero tokens replayed
+                ids2, done2 = self._attach_collect(doc["migrated_to"], rid)
+                if done2 is None or done2.get("status") != "done":
+                    self._bump("failovers")
+                    last_error = (
+                        f"migrated stream lost at {doc['migrated_to']}"
+                    )
+                    continue
+                self._bump("migration_resumes")
+                doc = {
+                    "status": "done",
+                    "tokens": (doc.get("tokens") or []) + ids2,
+                    "text": (doc.get("text") or "") + str(
+                        done2.get("text", "")
+                    ),
+                }
             n_tokens = len(doc.get("tokens") or ())
             self.registry.add_tokens(rep.id, n_tokens)
             self._bump("tokens_relayed", n_tokens)
@@ -1031,14 +1370,62 @@ class RouterServer:
         retry_after = 1.0
         last_error = "no routable replica"
         attempt = 0
-        while attempt < self.max_attempts:
+        disagg_tried = False
+        # a pending attach always gets its hop: attach hops don't consume
+        # the dispatch budget (they are migrations, not failures), so a
+        # stream migrated on its FINAL permitted dispatch must still follow
+        # its pages instead of dying "retry budget exhausted"
+        while attempt < self.max_attempts or state.get("attach"):
             relayed = len(state["ids"])
-            rep = self._route(orig_tokens, tried)
-            if rep is None:
-                break
-            attempt += 1
-            tried.add(rep.id)
-            body = self._hop_body(req, state["ids"], self.clock() - t0)
+            attach_to = state.pop("attach", None)
+            if attach_to is not None:
+                # zero-recompute hop: the stream's pages moved; follow them
+                # with an attach (no prompt re-send, no token replay). A
+                # ping-ponging fleet is bounded by the attach budget — past
+                # it the recompute fallback takes over.
+                state["attach_hops"] = state.get("attach_hops", 0) + 1
+                if state["attach_hops"] > 2 * self.max_attempts:
+                    # break to the terminal-error path below (headers are
+                    # sent by now): falling into the recompute branch here
+                    # would bypass its non-resumable-text-prompt guard
+                    last_error = "attach budget exhausted (migration loop)"
+                    break
+                rep = self._replica_for_url(attach_to)
+                hop_path = "/attach"
+                body = {"request_id": rid}
+            if attach_to is None:
+                if (
+                    not disagg_tried
+                    and not tried
+                    and not state["ids"]
+                    and self._disagg_enabled()
+                ):
+                    # fresh request on a disaggregated fleet: split it —
+                    # prefill at max batch on a prefill replica, pages
+                    # shipped to the decode replica we name, then attach
+                    disagg_tried = True
+                    plan = self._plan_disagg(orig_tokens)
+                    if plan is not None:
+                        ok, why = self._disagg_dispatch(
+                            plan[0], plan[1], req, rid, state
+                        )
+                        if ok:
+                            continue  # attach hop next
+                        last_error = why
+                        self._bump("disagg_fallbacks")
+                rep = self._route(orig_tokens, tried)
+                if rep is None:
+                    break
+                attempt += 1
+                tried.add(rep.id)
+                hop_path = "/generate"
+                body = self._hop_body(req, state["ids"], self.clock() - t0)
+                if relayed:
+                    # the recompute fallback re-sends every relayed token
+                    # as prompt — O(tokens) replay, the cost the attach
+                    # path exists to avoid (and the counter the
+                    # zero-replay proof pins)
+                    self._bump("resume_replayed_tokens", relayed)
             self.registry.inc_relay(rep.id)
             hop0 = self.clock()
             hop_tokens_before = relayed
@@ -1050,13 +1437,34 @@ class RouterServer:
                 try:
                     conn = self._connect(rep)
                     conn.request(
-                        "POST", "/generate", json.dumps(body),
+                        "POST", hop_path, json.dumps(body),
                         {"Content-Type": "application/json",
                          "X-Request-Id": rid},
                     )
                     resp = conn.getresponse()
                 except (OSError, http.client.HTTPException) as exc:
                     raise _HopDead(f"connect: {type(exc).__name__}: {exc}")
+                if hop_path == "/attach":
+                    if resp.status != 200:
+                        # the imported stream is not there (ingest failed,
+                        # got consumed, or the replica restarted):
+                        # recompute fallback — with suspicion only for 5xx
+                        # (a wedged handler must accrue ejection pressure;
+                        # a clean 404 is just a miss)
+                        resp.read()
+                        outcome = (
+                            "replica_5xx" if resp.status >= 500
+                            else "attach_miss"
+                        )
+                        detail = str(resp.status)
+                        raise _HopDead(
+                            f"attach at {rep.id} returned {resp.status}"
+                        )
+                    # counted on attach SUCCESS (matching the JSON path's
+                    # collect-then-count), not when the migrated done event
+                    # was merely seen — an attach miss is a fallback, not
+                    # a zero-replay resume
+                    self._bump("migration_resumes")
                 if resp.status != 200:
                     payload = resp.read()
                     try:
@@ -1127,6 +1535,14 @@ class RouterServer:
                     return
                 if kind == "done":
                     status = str(payload.get("status", "done"))
+                    if status == "migrated" and payload.get("migrated_to"):
+                        # the replica shipped this stream's pages (live
+                        # migration / drain-as-migrate): follow them with
+                        # an attach hop — zero tokens replayed (counted at
+                        # attach success, not here)
+                        state["attach"] = str(payload["migrated_to"])
+                        outcome, detail = "migrated", state["attach"]
+                        continue
                     if status == "failed" and payload.get("retryable", True):
                         # the replica's engine failed this request retryably
                         # (tick fault / poisoned slot): a clean SSE ending,
@@ -1381,6 +1797,9 @@ class RouterServer:
             t0 = self.clock()
             self.registry.cordon(rid)
             try:
+                migrated = self._migrate_off(rep)
+                if migrated:
+                    step["migrated_streams"] = migrated
                 if not self._await_zero_relays(rid, drain_timeout_s):
                     step["error"] = (
                         f"drain timeout: {rep.active_relays} relays still "
@@ -1429,6 +1848,173 @@ class RouterServer:
                 self.registry.uncordon(rid)
         self.flight.event("rolling_reload_end", ok=all_ok)
         return all_ok, results
+
+    def _migrate_off(self, rep: Replica) -> int:
+        """Drain-as-migrate: ask a cordoned replica to ship every live
+        stream to the best surviving decode-capable replica. Cost O(pages)
+        per stream instead of O(remaining tokens) of waiting; the open
+        relays see ``migrated`` done events and attach-resume at the
+        target. Best-effort: on any failure the classic wait-out drain
+        still runs (and mid-stream death still has the recompute path)."""
+        if not self.migrate_drain:
+            return 0
+        target = pick_decode_replica([
+            r for r in self.registry.routable()
+            if r.id != rep.id and r.role != "prefill" and r.importable
+            and r.draft_k == rep.draft_k
+        ])
+        if target is None:
+            return 0
+        target_url = (
+            target.url if "//" in target.url else f"http://{target.url}"
+        )
+        try:
+            code, doc = self._post_replica(
+                rep, "/admin/migrate_all", {"target": target_url},
+                timeout=5.0,
+            )
+        except (OSError, http.client.HTTPException):
+            return 0
+        if code != 202:
+            return 0
+        n = int(doc.get("requested", 0) or 0)
+        if n:
+            self._bump("migrations_requested", n)
+            self.flight.event(
+                "drain_migrate", replica=rep.id, target=target.id, streams=n,
+            )
+        return n
+
+    # ------------------------------------------------------------ autoscaler
+
+    def _autoscale_enabled(self) -> bool:
+        return self.autoscale_interval > 0 and self.scaler is not None
+
+    def _autoscale_loop(self) -> None:
+        while not self._stop.wait(self.autoscale_interval):
+            try:
+                self._autoscale_tick()
+            except Exception as exc:  # noqa: BLE001 — the control loop must outlive any one bad decision
+                self.flight.event("autoscale_error", error=repr(exc))
+
+    def _load_signals(self) -> Dict[str, Any]:
+        reps = self.registry.routable()
+        return {
+            "routable": len(reps),
+            "total": len(self.registry),
+            "queued": sum(r.queue_depth for r in reps),
+            "active": sum(r.active_slots + r.active_relays for r in reps),
+            "max_itl_ewma_ms": max(
+                (r.itl_ewma_ms for r in reps), default=0.0
+            ),
+            "min_free_pages": min((r.free_pages for r in reps), default=0),
+        }
+
+    def _autoscale_tick(self) -> None:
+        """One control-loop decision over the signals every probe already
+        scrapes. Deliberately hysteretic: ``scale_patience`` consecutive
+        breaches before acting, and up-pressure always resets the idle
+        streak (flapping costs replica churn AND migrations)."""
+        sig = self._load_signals()
+        n = sig["routable"]
+        if n == 0:
+            return  # nothing routable is an outage, not a scaling problem
+        hot = (
+            sig["queued"] / n >= self.scale_up_queue
+            or (
+                self.scale_up_itl_ms > 0
+                and sig["max_itl_ewma_ms"] >= self.scale_up_itl_ms
+            )
+            or (
+                self.scale_up_free_pages > 0
+                and sig["min_free_pages"] < self.scale_up_free_pages
+            )
+        )
+        idle = (
+            sig["queued"] == 0 and sig["active"] <= self.scale_down_active
+        )
+        if hot and sig["total"] < self.max_replicas:
+            self._idle_ticks = 0
+            self._hot_ticks += 1
+            if self._hot_ticks >= self.scale_patience:
+                self._hot_ticks = 0
+                self._scale_up(sig)
+        elif idle and sig["total"] > self.min_replicas:
+            self._hot_ticks = 0
+            self._idle_ticks += 1
+            if self._idle_ticks >= self.scale_patience:
+                self._idle_ticks = 0
+                self._scale_down(sig)
+        else:
+            self._hot_ticks = self._idle_ticks = 0
+
+    def _scale_up(self, sig: Dict[str, Any]) -> None:
+        try:
+            url = self.scaler.spawn()
+        except Exception as exc:  # noqa: BLE001 — a failed spawn is an event, not a router crash
+            self.flight.event("autoscale_spawn_failed", error=repr(exc))
+            return
+        if not url:
+            self.flight.event("autoscale_spawn_failed", error="no url")
+            return
+        rid = self.registry.add(url)
+        self._bump("autoscale_ups")
+        # the decision and its inputs, post-hoc diagnosable (obs satellite)
+        self.flight.event("autoscale_up", replica=rid, **sig)
+
+    def _pick_retire_victim(self) -> Optional[Replica]:
+        """Least-loaded routable replica that the fleet can lose: never the
+        last decode-capable replica, never the last prefill replica while
+        disaggregation is serving."""
+        reps = self.registry.routable()
+        decodes = [r for r in reps if r.role != "prefill"]
+        prefills = [r for r in reps if r.role == "prefill"]
+        candidates = []
+        for r in reps:
+            if r.role == "prefill" and len(prefills) <= 1 and decodes:
+                continue  # keep the disaggregated split alive
+            if r.role != "prefill" and len(decodes) <= 1:
+                continue  # never retire the last decode-capable replica
+            candidates.append(r)
+        if not candidates:
+            return None
+        return min(candidates, key=Replica.load_score)
+
+    def _scale_down(self, sig: Dict[str, Any]) -> None:
+        victim = self._pick_retire_victim()
+        if victim is None:
+            return
+        rid = victim.id
+        self.registry.cordon(rid)
+        try:
+            migrated = self._migrate_off(victim)
+            if not self._await_zero_relays(rid, self.scale_drain_timeout_s):
+                # live streams that could not move: abort the scale-down —
+                # capacity is cheaper than a dropped stream
+                self._bump("autoscale_aborts")
+                self.flight.event(
+                    "autoscale_down_aborted", replica=rid,
+                    active_relays=self.registry.get(rid).active_relays,
+                    **sig,
+                )
+                self.registry.uncordon(rid)
+                return
+        except Exception as exc:  # noqa: BLE001 — an aborted retire must leave the replica serving
+            self.flight.event("autoscale_error", error=repr(exc))
+            self.registry.uncordon(rid)
+            return
+        try:
+            self.scaler.retire(victim.url)
+        except Exception as exc:  # noqa: BLE001 — retire-hook failures are the operator's event to act on
+            self.flight.event(
+                "autoscale_retire_failed", replica=rid, error=repr(exc)
+            )
+        self.registry.remove(rid)
+        self.affinity.forget_replica(rid)
+        self._bump("autoscale_downs")
+        self.flight.event(
+            "autoscale_down", replica=rid, migrated=migrated, **sig
+        )
 
     def _await_zero_relays(self, rid: str, timeout_s: float) -> bool:
         deadline = time.monotonic() + timeout_s

@@ -52,19 +52,32 @@ deadline, then the process exits 0.
 """
 from __future__ import annotations
 
+import http.client
 import json
 import math
+import queue as queue_mod
 import select
 import signal
 import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
+from urllib.parse import urlsplit
 
 from zero_transformer_tpu.serving.detok import StreamDecoder, decode_tokens
-from zero_transformer_tpu.serving.engine import FAILED, REJECTED, ServingEngine
+from zero_transformer_tpu.serving.engine import (
+    FAILED,
+    MIGRATED,
+    REJECTED,
+    RequestHandle,
+    ServingEngine,
+)
 from zero_transformer_tpu.serving.resilience import READY, STOPPED, ReloadError
+from zero_transformer_tpu.serving.slots import (
+    page_span_from_wire,
+    page_span_to_wire,
+)
 
 # how long an SSE handler blocks on the next token before re-checking that
 # the client is still connected (a request parked in the admission queue, or
@@ -91,10 +104,31 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, tokenizer, host: str = "127.0.0.1",
                  port: int = 8000, max_body_bytes: int = 1 << 20,
-                 reload_source=None, admin_token: Optional[str] = None):
+                 reload_source=None, admin_token: Optional[str] = None,
+                 max_ingest_bytes: int = 256 << 20):
         self.engine = engine
         self.tokenizer = tokenizer
         self.max_body_bytes = max_body_bytes
+        # /ingest bodies carry raw KV pages — bounded separately from the
+        # JSON request bound (a real span is MBs where a prompt is KBs)
+        self.max_ingest_bytes = max_ingest_bytes
+        # imported streams awaiting their /attach (rid -> (handle,
+        # ingested_at)); the attach POPS, so a stream is consumed exactly
+        # once, and a TTL sweep cancels orphans (router died between the
+        # ship ack and the attach) so they cannot burn decode capacity or
+        # leak handles forever
+        self._pending_streams: Dict[str, tuple] = {}
+        self._streams_lock = threading.Lock()
+        self.attach_ttl_s = 300.0
+        # page shipper: the engine's tick thread enqueues (payload, target,
+        # on_done); this thread serializes + POSTs to <target>/ingest so
+        # the tick thread never blocks on a peer's socket
+        self._ship_queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._ship_thread = threading.Thread(
+            target=self._ship_loop, name="serve-shipper", daemon=True
+        )
+        if engine.page_shipper is None:
+            engine.page_shipper = self._enqueue_ship
         # reload source for SIGHUP / POST /admin/reload: a msgpack path, or
         # a loader callable — called with the request's path when one is
         # given, with no args otherwise (serve.py's loader replays the full
@@ -156,7 +190,9 @@ class ServingServer:
 
             def do_POST(self):  # noqa: N802
                 if self.path not in (
-                    "/generate", "/admin/reload", "/admin/profile",
+                    "/generate", "/attach", "/ingest",
+                    "/admin/reload", "/admin/profile",
+                    "/admin/migrate", "/admin/migrate_all",
                 ):
                     self._json(404, {"error": f"no route {self.path}"})
                     return
@@ -164,6 +200,19 @@ class ServingServer:
                     length = int(self.headers.get("Content-Length", 0))
                 except ValueError:
                     self._json(400, {"error": "bad Content-Length"})
+                    return
+                if self.path == "/ingest":
+                    # binary page-span body — its own (much larger) bound,
+                    # and no JSON parse
+                    if length < 0 or length > outer.max_ingest_bytes:
+                        self.close_connection = True
+                        self._json(413 if length > 0 else 400, {
+                            "error": (
+                                f"ingest body must be 0..{outer.max_ingest_bytes} bytes"
+                            ),
+                        })
+                        return
+                    outer._ingest(self, self.rfile.read(length))
                     return
                 if length < 0:
                     # rfile.read(-1) would read until EOF — unbounded, the
@@ -196,8 +245,14 @@ class ServingServer:
                         return
                     if self.path == "/admin/reload":
                         self._json(*outer._reload(req))
+                    elif self.path == "/admin/migrate":
+                        self._json(*outer._migrate(req))
+                    elif self.path == "/admin/migrate_all":
+                        self._json(*outer._migrate_all(req))
                     else:
                         self._json(*outer._profile(req))
+                elif self.path == "/attach":
+                    outer._attach(self, req)
                 else:
                     outer._generate(self, req)
 
@@ -215,6 +270,8 @@ class ServingServer:
         STARTING (tests assert /healthz is 503 before readiness; a real
         deployment would use it to finish warmup before taking traffic) —
         call ``start_scheduler()`` to go READY."""
+        if not self._ship_thread.ident:
+            self._ship_thread.start()
         if start_scheduler:
             self.start_scheduler()
         self._server_thread = threading.Thread(
@@ -223,10 +280,14 @@ class ServingServer:
         self._server_thread.start()
 
     def start_scheduler(self) -> None:
+        if not self._ship_thread.ident:
+            self._ship_thread.start()
         if not self._scheduler.ident:
             self._scheduler.start()
 
     def serve_forever(self) -> None:
+        if not self._ship_thread.ident:
+            self._ship_thread.start()
         self.start_scheduler()
         try:
             self._httpd.serve_forever()
@@ -243,6 +304,10 @@ class ServingServer:
         """(code, body) for /healthz: 200 ONLY when the engine is READY and
         its scheduler thread is alive — warming up, degraded, draining, and
         stopped all answer 503 so a load balancer stops routing here."""
+        # orphan sweep rides the health poll (routers probe every replica
+        # continuously), so a replica that stops receiving ingest/attach
+        # traffic still cancels un-attached imports at the TTL
+        self._sweep_pending_streams()
         state = self.engine.lifecycle.state
         alive = self._scheduler.is_alive() or not self._scheduler.ident
         if not alive and state != STOPPED:
@@ -267,6 +332,19 @@ class ServingServer:
             "queue_depth": self.engine.queue_depth,
             "active_slots": self.engine.active_count,
             "free_pages": self.engine.free_pages,
+            # disaggregation inputs (ISSUE 12): the router's role-aware
+            # placement reads both off the same cheap poll, and the
+            # page-pool pressure stats ride along so the router can mirror
+            # them as per-replica gauges without a /metrics scrape
+            "role": self.engine.role,
+            "kv_layout": self.engine.kv_layout,
+            "draft_k": self.engine.draft_k,
+            "migrations_in_flight": self.engine.migrations_in_flight,
+            "page_faults": self.engine.stats["page_faults"],
+            "cow_copies": (
+                self.engine.slots.cow_copies
+                if self.engine.kv_layout == "paged" else 0
+            ),
         }
 
     def _admin_allowed(self, handler) -> bool:
@@ -336,6 +414,156 @@ class ServingServer:
             return 409, {"error": str(exc), "state": self.engine.lifecycle.state}
         return 202, {"accepted": True, **info}
 
+    # -------------------------------------------- disaggregation / migration
+
+    def _enqueue_ship(self, payload: dict, target: str, on_done) -> None:
+        """The engine's ``page_shipper`` seam: hand the export to the
+        shipper thread and return immediately — the tick thread never
+        blocks on a peer replica's socket."""
+        self._ship_queue.put((payload, target, on_done))
+
+    def _ship_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._ship_queue.get(timeout=0.2)
+            except queue_mod.Empty:
+                continue
+            payload, target, on_done = item
+            try:
+                err = self._ship_once(payload, target)
+            except Exception as exc:  # noqa: BLE001 — a shipper crash must fail ONE migration, not the thread
+                err = f"{type(exc).__name__}: {exc}"
+            on_done(err)
+
+    def _ship_once(self, payload: dict, target: str) -> Optional[str]:
+        """POST one page-span payload to ``<target>/ingest``. Returns None
+        on an accepted ingest, else a reason string (the engine fails that
+        migration retryably and the router falls back to recompute)."""
+        blob = page_span_to_wire(payload)
+        parts = urlsplit(target if "//" in target else f"http://{target}")
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or 80
+        conn = http.client.HTTPConnection(host, port, timeout=30.0)
+        try:
+            conn.request(
+                "POST", "/ingest", blob,
+                {"Content-Type": "application/octet-stream",
+                 "X-Request-Id": str(payload.get("request_id", ""))},
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                try:
+                    doc = json.loads(body or b"{}")
+                except ValueError:
+                    doc = {}
+                return (
+                    f"ingest at {target} returned {resp.status}: "
+                    f"{doc.get('error', '')}"
+                )
+            return None
+        except (OSError, http.client.HTTPException) as exc:
+            return f"ship to {target} failed: {type(exc).__name__}: {exc}"
+        finally:
+            conn.close()
+
+    def _ingest(self, handler, blob: bytes) -> None:
+        """POST /ingest: accept a migrated stream's pages + carry. The
+        imported handle parks in the pending-streams table until the
+        router ATTACHES (tokens that decode meanwhile buffer in the
+        handle's queue — nothing is lost, TTFT overlaps the attach)."""
+        try:
+            payload = page_span_from_wire(blob)
+        except ValueError as exc:
+            handler._json(400, {"error": f"bad page-span body: {exc}"})
+            return
+        handle = self.engine.import_stream(payload)
+        if handle.status in (REJECTED, FAILED):
+            code = 503 if handle.retryable else 409
+            handler._json(code, {
+                "error": handle.error, "status": handle.status,
+                "request_id": handle.rid,
+            }, headers={"X-Request-Id": handle.rid})
+            return
+        self._sweep_pending_streams()
+        with self._streams_lock:
+            displaced = self._pending_streams.pop(handle.rid, None)
+            self._pending_streams[handle.rid] = (handle, time.monotonic())
+        if displaced is not None:
+            # duplicate rid (a re-shipped stream whose earlier ingest ack
+            # was lost): the NEW import is the live one — cancel the
+            # displaced handle so it cannot decode its budget unwatched
+            displaced[0].cancel()
+        handler._json(200, {
+            "accepted": True, "request_id": handle.rid,
+        }, headers={"X-Request-Id": handle.rid})
+
+    def _sweep_pending_streams(self) -> None:
+        """Cancel + drop imported streams nobody attached within the TTL:
+        an orphan (its router died between ship ack and attach) must not
+        decode its whole budget into the void or leak its handle."""
+        cutoff = time.monotonic() - self.attach_ttl_s
+        with self._streams_lock:
+            stale = [
+                rid for rid, (_, t0) in self._pending_streams.items()
+                if t0 < cutoff
+            ]
+            dropped = [self._pending_streams.pop(rid) for rid in stale]
+        for handle, _ in dropped:
+            handle.cancel()
+
+    def _attach(self, handler, req: dict) -> None:
+        """POST /attach {"request_id"}: take over an imported stream's SSE.
+        Pops the pending entry — a stream attaches exactly once; an unknown
+        id is a clean 404 (the router then falls back to recompute)."""
+        self._sweep_pending_streams()
+        rid = str(req.get("request_id", ""))
+        with self._streams_lock:
+            handle, _ = self._pending_streams.pop(rid, (None, 0.0))
+        if handle is None:
+            handler._json(404, {
+                "error": f"no pending stream {rid!r}", "request_id": rid,
+            }, headers={"X-Request-Id": rid})
+            return
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/event-stream")
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("X-Request-Id", handle.rid)
+            handler.end_headers()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the attacher vanished between POST and headers: the entry is
+            # already popped (attach is consume-once), so cancel — the
+            # stream must not decode its budget into the void; the
+            # router's retry gets a 404 and the recompute fallback covers
+            handle.cancel()
+            return
+        self._stream_events(handler, handle)
+
+    def _migrate(self, req: dict):
+        """(code, body) for POST /admin/migrate {"request_id", "target"}:
+        tag one live stream for migration. The export happens between
+        ticks; the stream's open SSE ends with a ``migrated`` done event
+        naming the target, which the router turns into an attach hop."""
+        rid = str(req.get("request_id", ""))
+        target = str(req.get("target", ""))
+        if not rid or not target:
+            return 400, {"error": "request_id and target are required"}
+        if self.engine.request_migration(rid, target):
+            return 202, {"requested": True, "request_id": rid,
+                         "target": target}
+        return 404, {"error": f"no live stream {rid!r}", "request_id": rid}
+
+    def _migrate_all(self, req: dict):
+        """(code, body) for POST /admin/migrate_all {"target"}: migrate
+        every live stream (drain-as-migrate: rolling reload and scale-down
+        use this instead of waiting out in-flight generations)."""
+        target = str(req.get("target", ""))
+        if not target:
+            return 400, {"error": "target is required"}
+        n = self.engine.request_migrate_all(target)
+        return 202, {"requested": n, "target": target}
+
     def drain(self, deadline_s: Optional[float] = 30.0) -> None:
         """Begin a graceful drain and, once the engine reports STOPPED (or
         the deadline plus grace expires), shut the HTTP server down.
@@ -396,6 +624,9 @@ class ServingServer:
             seed=int(req.get("seed", 0)),
             timeout=float(req["timeout"]) if "timeout" in req else None,
             request_id=request_id,
+            prefill_to=(
+                str(req["prefill_to"]) if req.get("prefill_to") else None
+            ),
         )
 
     def _generate(self, handler, req: dict) -> None:
@@ -451,16 +682,27 @@ class ServingServer:
                                     "request_id": handle.rid}, headers=rid_hdr)
                 return
             text = self._full_text(tokens)
-            handler._json(200, {
+            doc = {
                 "status": handle.status, "tokens": tokens, "text": text,
                 "request_id": handle.rid,
-            }, headers=rid_hdr)
+            }
+            if handle.status == MIGRATED:
+                # disaggregated handoff: the stream continues at this
+                # replica — the router's attach hop picks it up there
+                doc["migrated_to"] = handle.migrated_to
+            handler._json(200, doc, headers=rid_hdr)
             return
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
         handler.send_header("Cache-Control", "no-cache")
         handler.send_header("X-Request-Id", handle.rid)
         handler.end_headers()
+        self._stream_events(handler, handle)
+
+    def _stream_events(self, handler, handle) -> None:
+        """Pump one handle's token events onto an SSE connection whose
+        headers are already sent (shared by /generate streams and /attach
+        takeovers of imported streams)."""
         decoder = StreamDecoder(self.tokenizer)
         pieces: list = []
         eos = self.engine.eos_token_id
@@ -502,7 +744,7 @@ class ServingServer:
             if tail is not None:
                 pieces.append(tail)
                 self._event(handler, {"text": tail})
-            self._event(handler, {
+            done = {
                 "done": True,
                 "status": handle.status,
                 "text": "".join(pieces),
@@ -511,7 +753,12 @@ class ServingServer:
                 # failure mid-stream is resumed on another replica
                 "retryable": handle.retryable,
                 "request_id": handle.rid,
-            })
+            }
+            if handle.status == MIGRATED:
+                # zero-recompute handoff: the router attaches at the named
+                # replica and the client stream continues seamlessly
+                done["migrated_to"] = handle.migrated_to
+            self._event(handler, done)
         except (BrokenPipeError, ConnectionResetError):
             # client went away: release the slot instead of decoding into
             # the void

@@ -19,6 +19,8 @@ scalars — so admissions, retirements, and occupancy changes never recompile.
 from __future__ import annotations
 
 import functools
+import json
+import struct
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -323,6 +325,155 @@ class SlotKVCache:
 # a page while any live slot or cached prefix still maps it.
 
 
+# ---- transferable page spans (disaggregated prefill/decode + migration) ----
+#
+# A page span is the HOST-side image of one slot's leading pages: the raw
+# K/V bytes (int8 scale leaves included) of every pool leaf plus the
+# block-table fragment's geometry. It is the unit that moves between
+# replicas — a prefill replica ships finished spans to a decode replica,
+# and live migration ships a mid-stream slot's span to its new home. The
+# gather/scatter programs are compiled per QUANTIZED page count (power of
+# two, same discipline as the span ops above) so diverse sequence lengths
+# cannot compile-storm a long-lived replica; padding routes through the
+# trash page (gather pads are sliced off host-side, scatter pads write
+# garbage into page 0, which nothing ever reads).
+
+_WIRE_MAGIC = b"ZTPG1"
+
+
+def _dtype_token(dt) -> str:
+    """Wire token for a numpy dtype. Extension dtypes (bfloat16, fp8s —
+    numpy kind 'V') stringify to an OPAQUE void ('|V2') that the receiver
+    cannot reconstruct; ship their NAME instead."""
+    dt = np.dtype(dt)
+    return dt.name if dt.kind == "V" else dt.str
+
+
+def _dtype_from_token(token: str):
+    try:
+        return np.dtype(token)
+    except TypeError:
+        pass
+    # extension dtype by name (bfloat16 etc.) — ml_dtypes ships with jax,
+    # so this resolves wherever the pools themselves can exist. An unknown
+    # token must surface as ValueError (the wire contract: torn/foreign
+    # blobs become a clean 400, never a handler traceback).
+    import ml_dtypes
+
+    try:
+        return np.dtype(getattr(ml_dtypes, str(token)))
+    except (AttributeError, TypeError) as exc:
+        raise ValueError(f"unknown dtype token {token!r}") from exc
+
+
+@jax.jit
+def _gather_pages_impl(cache, page_ids):
+    """Pull pool pages out of every K/V pool leaf in ONE dispatch:
+    {leaf path -> [len(page_ids), ...per-page]} with the page axis moved
+    to the front so row ``i`` is page ``page_ids[i]`` whatever the pool
+    layout (per-layer [n_pages, page, KVH, D] or scanned
+    [L, n_pages, ...]). The compile family is keyed by ``page_ids``'s
+    (quantized) length — the caller pads to a power of two."""
+    out: Dict[str, jax.Array] = {}
+
+    def grab(path, leaf):
+        if _leaf_name(path) not in POOL_LEAVES:
+            return
+        ax = leaf.ndim - 4
+        v = jnp.moveaxis(leaf, ax, 0)
+        out[jax.tree_util.keystr(path)] = jnp.take(v, page_ids, axis=0)
+
+    jax.tree_util.tree_map_with_path(grab, cache)
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages_impl(cache, page_ids, spans):
+    """Inverse of ``_gather_pages_impl``: write span rows into the pool
+    pages named by ``page_ids``, one dispatch across every pool leaf.
+    Padding rows target the trash page (id 0) — harmless by design."""
+
+    def put(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if key not in spans:
+            return leaf
+        ax = leaf.ndim - 4
+        v = jnp.moveaxis(leaf, ax, 0)
+        v = v.at[page_ids].set(spans[key].astype(v.dtype))
+        return jnp.moveaxis(v, 0, ax)
+
+    return jax.tree_util.tree_map_with_path(put, cache)
+
+
+@jax.jit
+def _set_index_slot(cache: Any, slot: jax.Array, value: jax.Array) -> Any:
+    """Set ONE slot's fill cursor in every index leaf (migration import:
+    the destination's cursor is host-known — prompt + emitted — and the
+    imported pages already hold the K/V at [0, cursor))."""
+
+    def upd(path, leaf):
+        if _leaf_name(path) not in INDEX_LEAVES:
+            return leaf
+        block = jnp.full(leaf.shape[:-1] + (1,), value, leaf.dtype)
+        starts = (0,) * (leaf.ndim - 1) + (slot,)
+        return jax.lax.dynamic_update_slice(leaf, block, starts)
+
+    return jax.tree_util.tree_map_with_path(upd, cache)
+
+
+def page_span_to_wire(payload: Dict[str, Any]) -> bytes:
+    """Serialize a page-span payload (and any JSON-safe extras riding in
+    it) to one self-describing byte string: magic + length-prefixed JSON
+    header + the leaf buffers concatenated raw. No base64 inflation, no
+    pickle — the format is readable by any stdlib-only peer."""
+    leaves = payload.get("leaves", {})
+    header = {
+        k: v for k, v in payload.items() if k != "leaves"
+    }
+    header["leaves"] = []
+    buffers: List[bytes] = []
+    for key in sorted(leaves):
+        arr = np.ascontiguousarray(leaves[key])
+        header["leaves"].append({
+            "key": key,
+            "dtype": _dtype_token(arr.dtype),
+            "shape": list(arr.shape),
+            "nbytes": int(arr.nbytes),
+        })
+        buffers.append(arr.tobytes())
+    head = json.dumps(header).encode()
+    return b"".join(
+        [_WIRE_MAGIC, struct.pack("<I", len(head)), head, *buffers]
+    )
+
+
+def page_span_from_wire(blob: bytes) -> Dict[str, Any]:
+    """Parse ``page_span_to_wire`` output back into the payload dict.
+    Raises ValueError on a torn or foreign blob — the ingest endpoint maps
+    that to a clean 400, never a handler traceback."""
+    if len(blob) < len(_WIRE_MAGIC) + 4 or not blob.startswith(_WIRE_MAGIC):
+        raise ValueError("not a page-span wire blob")
+    off = len(_WIRE_MAGIC)
+    (head_len,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    try:
+        header = json.loads(blob[off : off + head_len])
+    except ValueError as exc:
+        raise ValueError(f"torn page-span header: {exc}") from exc
+    off += head_len
+    leaves: Dict[str, np.ndarray] = {}
+    for meta in header.pop("leaves", []):
+        n = int(meta["nbytes"])
+        if off + n > len(blob):
+            raise ValueError("page-span blob truncated mid-buffer")
+        leaves[meta["key"]] = np.frombuffer(
+            blob[off : off + n], dtype=_dtype_from_token(meta["dtype"])
+        ).reshape(meta["shape"])
+        off += n
+    header["leaves"] = leaves
+    return header
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _copy_page(cache: Any, src: jax.Array, dst: jax.Array) -> Any:
     """Copy pool page ``src`` onto ``dst`` in every K/V pool leaf, one
@@ -557,6 +708,125 @@ class PagedKVCache:
         self.cow_copies += 1
         self.tables_dirty = True
         return True
+
+    # ---- transferable page spans (export / import) -----------------------
+
+    def _quantized_blocks(self, count: int) -> int:
+        """Gather/scatter page counts are STATIC in the compiled transfer
+        ops — quantize to the next power of two (capped at the per-slot
+        block capacity) so the compile family stays ~log2(n_blocks)."""
+        b = 1
+        while b < count:
+            b *= 2
+        return min(b, max(1, self.n_blocks))
+
+    # graftlint: hot-path
+    def export_page_span(self, slot: int, n_tokens: int) -> Dict[str, Any]:
+        """HOST-side image of the slot's leading pages covering positions
+        ``[0, n_tokens)``: raw K/V bytes per pool leaf (int8 scales
+        included) + the block-table fragment geometry. Read-only — the
+        slot keeps its pages and refcounts are untouched, so an export
+        followed by a failed ship leaves the source stream intact."""
+        n_blocks = self.blocks_for(n_tokens)
+        if n_blocks > self.alloc_blocks[slot]:
+            raise ValueError(
+                f"slot {slot} maps {self.alloc_blocks[slot]} blocks; "
+                f"export of {n_blocks} requested"
+            )
+        pages = [int(p) for p in self.table[slot, :n_blocks]]
+        padded = self._quantized_blocks(n_blocks)
+        ids = pages + [PagePool.TRASH] * (padded - n_blocks)
+        spans = _gather_pages_impl(self.cache, jnp.asarray(ids, jnp.int32))
+        # graftlint: allow[host-sync-in-hot-path] reason=THE designed migration-send sync — one coalesced device_get of the whole span, off the engine lock, only when a stream actually migrates
+        host = jax.device_get(spans)
+        return {
+            "page_size": self.page_size,
+            "n_blocks": n_blocks,
+            "n_tokens": int(n_tokens),
+            "leaves": {k: v[:n_blocks] for k, v in host.items()},
+        }
+
+    # graftlint: hot-path
+    def import_page_span(self, slot: int, payload: Dict[str, Any]) -> bool:
+        """Materialize an exported span as ``slot``'s leading blocks:
+        allocate fresh pages, scatter the bytes in (ONE dispatch), and map
+        them in the host table. Bit-exact by construction (raw bytes, same
+        dtypes). Returns False when the pool cannot cover the span (the
+        caller falls back or waits); raises ValueError on a structurally
+        incompatible payload (page size / leaf geometry mismatch — that is
+        a wrong-fleet bug, not a capacity condition).
+
+        Imported pages are ordinary refcounted pool pages (ref 1, owned by
+        the slot): bank/share them and the standard copy-on-write guard
+        protects any post-import write to a shared page."""
+        if self.alloc_blocks[slot] != 0:
+            raise ValueError("import_page_span needs an empty slot")
+        # graftlint: allow[host-sync-in-hot-path] reason=wire-payload fields are host ints (json header), never device values
+        page_size, n_blocks = int(payload["page_size"]), int(payload["n_blocks"])
+        if page_size != self.page_size:
+            raise ValueError(
+                f"page-span page_size {page_size} != pool "
+                f"page_size {self.page_size}"
+            )
+        if n_blocks > self.n_blocks:
+            raise ValueError(
+                f"span of {n_blocks} blocks exceeds per-slot capacity "
+                f"{self.n_blocks}"
+            )
+        leaves = payload["leaves"]
+        expect = {}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(self.cache):
+            if _leaf_name(path) in POOL_LEAVES:
+                key = jax.tree_util.keystr(path)
+                ax = leaf.ndim - 4
+                shape = tuple(
+                    d for i, d in enumerate(leaf.shape) if i != ax
+                )
+                expect[key] = (shape, leaf.dtype)
+        if set(leaves) != set(expect):
+            raise ValueError(
+                f"page-span leaves {sorted(leaves)} != pool leaves "
+                f"{sorted(expect)}"
+            )
+        for key, arr in leaves.items():
+            shape, dtype = expect[key]
+            if tuple(arr.shape) != (n_blocks,) + shape or np.dtype(
+                arr.dtype
+            ) != np.dtype(dtype):
+                raise ValueError(
+                    f"page-span leaf {key} is {arr.dtype}{arr.shape}; "
+                    f"pool expects {np.dtype(dtype).str}[{n_blocks}]+{shape}"
+                )
+        fresh: List[int] = []
+        for _ in range(n_blocks):
+            page = self.pool.alloc()
+            if page is None:
+                self.pool.decref(fresh)  # roll the partial allocation back
+                return False
+            fresh.append(page)
+        padded = self._quantized_blocks(n_blocks)
+        ids = fresh + [PagePool.TRASH] * (padded - n_blocks)
+        spans = {}
+        for key, arr in leaves.items():
+            pad = np.zeros(
+                (padded - n_blocks,) + arr.shape[1:], dtype=arr.dtype
+            )
+            spans[key] = jnp.asarray(np.concatenate([arr, pad], axis=0))
+        self.cache = _scatter_pages_impl(
+            self.cache, jnp.asarray(ids, jnp.int32), spans
+        )
+        for b, p in enumerate(fresh):
+            self.table[slot, b] = p
+        self.alloc_blocks[slot] = n_blocks
+        self.tables_dirty = True
+        return True
+
+    def set_cursor(self, slot: int, value: int) -> None:
+        """Set the slot's fill cursor in every index leaf (import install:
+        the host knows the migrated stream's exact position)."""
+        self.cache = _set_index_slot(
+            self.cache, jnp.int32(slot), jnp.int32(value)
+        )
 
     def reset_slot_pages(self, slot: int) -> None:
         """Drop every page the slot maps WITHOUT freeing the slot itself
