@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos disagg-chaos obs bench bench-watch serve-bench train-bench kernel-bench e2e-watch fmt fmt-check dryrun lint
+.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos disagg-chaos obs bench bench-watch serve-bench train-bench kernel-bench tune tune-smoke e2e-watch fmt fmt-check dryrun lint
 
 # Invariant lint lane (ISSUE 10): graftlint's repo-specific AST rules +
 # the suppression audit over the whole tree. Pure stdlib — no jax import,
@@ -181,6 +181,32 @@ kernel-bench:
 		tests/test_flash_attention.py -q $(PYTEST_ARGS)
 	JAX_PLATFORMS=cpu $(PY) -c "import bench, json; out = bench.child_flash(); \
 		print(json.dumps(out)); assert out['ok'], 'kernel parity failed'"
+
+# Autotuner lanes (ISSUE 14, docs/TUNING.md). `tune` runs the real
+# per-(model, hardware, workload) searches and rewrites the committed
+# TUNE_train.json / TUNE_serve.json (re-run on new hardware — the
+# artifacts only ever apply under a matching platform block). `tune-smoke`
+# is the CI lane: a tiny space, 2 measured trials, two full passes, and
+# asserts the artifact schema plus determinism (same winner + same trace
+# fingerprint across the passes — the --reruns 2 gate inside the script),
+# mirroring the BENCH schema tests; the committed-artifact schema itself
+# is pinned by tests/test_autotune.py (TUNE_REQUIRED_KEYS).
+tune:
+	JAX_PLATFORMS=cpu $(PY) scripts/autotune.py --target serve --reruns 2
+	JAX_PLATFORMS=cpu $(PY) scripts/autotune.py --target train --reruns 2
+
+tune-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/autotune.py --target serve --smoke \
+		--reruns 2 --out /tmp/_tune_smoke.json
+	$(PY) -c "import json; \
+		from zero_transformer_tpu.analysis.autotune import TUNE_REQUIRED_KEYS; \
+		art = json.load(open('/tmp/_tune_smoke.json')); \
+		missing = TUNE_REQUIRED_KEYS - art.keys(); \
+		assert not missing, f'smoke artifact missing {sorted(missing)}'; \
+		det = art['determinism']; \
+		assert det['winner_stable'] and det['fingerprints_equal'], det; \
+		print(f\"tune-smoke ok: winner {art['winner']['knobs']} \" \
+		      f\"({art['value']}x), fingerprint {det['fingerprint']}\")"
 
 # Retry the bench ladder until a live on-chip measurement lands, then promote
 # it to BENCH_measured.json (this image's TPU tunnel wedges for hours at a

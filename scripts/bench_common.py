@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Shared bench-artifact provenance logic: the platform-match /
+skip-or-grade rules both regression guards apply, in ONE place.
+
+Extracted from ``serve_bench_guard.py`` and ``train_bench_guard.py``
+(ISSUE 14): the two copies of "grade perf only on matching hardware, skip
+loudly otherwise" had drifted across the router/disagg compare functions.
+The autotuner reuses the same gate for its committed TUNE artifacts:
+``train.py --tuned`` / ``serve.py --tuned`` refuse an artifact whose
+platform/model/workload does not match the current run, exactly the
+BENCH honesty discipline.
+
+Pure stdlib (argparse-free, jax imported lazily only by
+``platform_block``) so the guards stay cheap to exec.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+
+def load_artifact(path) -> Dict[str, Any]:
+    return json.loads(Path(path).read_text())
+
+
+def platform_block() -> Dict[str, Any]:
+    """The current process' platform block, in the shape every TUNE
+    artifact embeds. Includes ``device_count``: a knob ranking measured on
+    8 virtual devices is NOT the same platform as 1 real device, and the
+    --tuned gate must be able to tell (dict equality covers it). Imports
+    jax lazily — guard scripts comparing two JSON files never pay backend
+    init."""
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "device_count": jax.device_count(),
+    }
+
+
+def hardware_gate(
+    baseline: Dict[str, Any],
+    fresh: Dict[str, Any],
+    fields: Sequence[str] = ("platform",),
+    what: str = "not comparable",
+) -> Tuple[bool, Optional[str]]:
+    """Skip-or-grade on hardware identity: (True, None) when every
+    ``fields`` entry is present in both artifacts and equal; otherwise
+    (False, "SKIP: ..."). A skip is a PASS for a guard — its job is
+    catching real regressions on comparable runs, not adding noise on
+    incomparable ones."""
+    base_hw = tuple(baseline.get(f) for f in fields)
+    fresh_hw = tuple(fresh.get(f) for f in fields)
+    # `not v` (not just None): an empty platform block is as unknown as a
+    # missing one — two empty blocks comparing equal must not grade perf
+    if any(not v for v in base_hw + fresh_hw):
+        return False, (
+            f"SKIP: baseline or fresh artifact lacks {'/'.join(fields)}"
+        )
+    if base_hw != fresh_hw:
+        b = base_hw[0] if len(fields) == 1 else base_hw
+        f = fresh_hw[0] if len(fields) == 1 else fresh_hw
+        return False, (
+            f"SKIP: hardware mismatch (baseline {b} vs fresh {f}); {what}"
+        )
+    return True, None
+
+
+def correctness_gate(baseline: Dict[str, Any], fresh: Dict[str, Any]) -> bool:
+    """The grade decision for artifacts whose CORRECTNESS fields grade on
+    any hardware while their perf numbers are baseline-gated (router,
+    disagg): perf grades only when the baseline carries the same metric
+    AND an identical platform block. This is the logic that had drifted
+    between the two copies."""
+    return (
+        baseline.get("metric") == fresh.get("metric")
+        and bool(baseline.get("platform"))
+        and baseline.get("platform") == fresh.get("platform")
+    )
+
+
+def provenance_gate(
+    baseline: Dict[str, Any], fresh: Dict[str, Any]
+) -> Tuple[bool, Optional[str]]:
+    """Measured and projected numbers are never compared to each other."""
+    if baseline.get("provenance") == fresh.get("provenance"):
+        return True, None
+    return False, (
+        f"SKIP reduction: provenance changed "
+        f"({baseline.get('provenance')} -> {fresh.get('provenance')})"
+    )
+
+
+def load_tuned(
+    path,
+    platform: Optional[Dict[str, str]] = None,
+    model: Optional[str] = None,
+    workload_hash: Optional[str] = None,
+    target: Optional[str] = None,
+) -> Tuple[Optional[Dict[str, Any]], list]:
+    """Read + gate a TUNE artifact in one step — the shared flow behind
+    ``train.py --tuned`` and ``serve.py --tuned`` (one implementation, so
+    the two surfaces cannot drift on what "matching" means). Returns
+    (artifact, []) when it applies, (None, reasons) when it must be
+    refused — including an unreadable file, which is a refusal, not a
+    crash."""
+    try:
+        artifact = load_artifact(path)
+    except (OSError, ValueError) as e:
+        return None, [f"unreadable: {e}"]
+    ok, reasons = check_tuned(
+        artifact, platform=platform, model=model,
+        workload_hash=workload_hash, target=target,
+    )
+    return (artifact, []) if ok else (None, reasons)
+
+
+def check_tuned(
+    artifact: Dict[str, Any],
+    platform: Optional[Dict[str, str]] = None,
+    model: Optional[str] = None,
+    workload_hash: Optional[str] = None,
+    target: Optional[str] = None,
+) -> Tuple[bool, list]:
+    """Gate a TUNE_<target>.json artifact against the CURRENT run: the
+    tuned defaults only apply where they were measured. Returns
+    (ok, reasons); every mismatch is listed so the refusal names exactly
+    what disagrees (platform, model, workload, target)."""
+    reasons = []
+    if not isinstance(artifact, dict) or "winner" not in artifact:
+        return False, ["artifact has no winner block (not a TUNE artifact?)"]
+    art_platform = artifact.get("platform")
+    if not art_platform:
+        reasons.append("artifact lacks a platform block")
+    elif platform is not None and art_platform != platform:
+        reasons.append(
+            f"platform mismatch: tuned on {art_platform}, running on "
+            f"{platform}"
+        )
+    if target is not None and artifact.get("target") != target:
+        reasons.append(
+            f"target mismatch: artifact tunes {artifact.get('target')!r}, "
+            f"this is a {target!r} run"
+        )
+    if model is not None and artifact.get("model") != model:
+        reasons.append(
+            f"model mismatch: tuned for {artifact.get('model')!r}, "
+            f"running {model!r}"
+        )
+    if (
+        workload_hash is not None
+        and artifact.get("workload_hash") != workload_hash
+    ):
+        reasons.append(
+            f"workload mismatch: tuned under workload "
+            f"{artifact.get('workload_hash')!r}, this run replays "
+            f"{workload_hash!r}"
+        )
+    return (not reasons), reasons

@@ -31,6 +31,9 @@ import json
 import sys
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+import bench_common  # noqa: E402  (shared skip-or-grade logic, ISSUE 14)
+
 TOLERANCE = 0.15
 # span tracing must cost <= this fraction of decode tok/s (ISSUE 7): the
 # A/B inside one artifact ran both arms on the same box minutes apart, so
@@ -235,13 +238,11 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
     """Returns (ok, messages). ok=True covers both pass and skip."""
     msgs = []
     # the disagg artifact dispatches before the generic platform gate too:
-    # its correctness fields + within-artifact A/B grade everywhere
+    # its correctness fields + within-artifact A/B grade everywhere; the
+    # perf grade decision is the ONE shared rule (bench_common, ISSUE 14 —
+    # the router/disagg copies of this predicate had drifted)
     if str(fresh.get("metric", "")) == "disagg_flood_and_autoscale":
-        grade = (
-            baseline.get("metric") == fresh.get("metric")
-            and bool(baseline.get("platform"))
-            and baseline.get("platform") == fresh.get("platform")
-        )
+        grade = bench_common.correctness_gate(baseline, fresh)
         return compare_disagg(
             baseline if grade else {}, fresh, tolerance, grade_perf=grade
         )
@@ -249,23 +250,13 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
     # correctness fields must grade everywhere, only its scaling perf is
     # hardware-gated
     if str(fresh.get("metric", "")) == "router_scaling_tok_s":
-        grade = (
-            baseline.get("metric") == fresh.get("metric")
-            and bool(baseline.get("platform"))
-            and baseline.get("platform") == fresh.get("platform")
-        )
+        grade = bench_common.correctness_gate(baseline, fresh)
         return compare_router(
             baseline if grade else {}, fresh, tolerance, grade_scaling=grade
         )
-    base_platform = baseline.get("platform")
-    fresh_platform = fresh.get("platform")
-    if not base_platform or not fresh_platform:
-        return True, ["SKIP: baseline or fresh artifact lacks a platform block"]
-    if base_platform != fresh_platform:
-        return True, [
-            f"SKIP: hardware mismatch (baseline {base_platform} vs "
-            f"fresh {fresh_platform}); not comparable"
-        ]
+    hw_ok, hw_reason = bench_common.hardware_gate(baseline, fresh)
+    if not hw_ok:
+        return True, [hw_reason]
     if baseline.get("metric") != fresh.get("metric"):
         return True, ["SKIP: different metrics; not comparable"]
     if str(baseline.get("metric", "")).startswith("serve_capacity"):

@@ -170,6 +170,20 @@ def parse_args(argv=None):
                    help="admission-queue depth (large: the loadgen measures "
                         "latency under queueing, not reject behavior)")
     p.add_argument("--seed", type=int, default=0, help="base request seed")
+    p.add_argument("--workload", default=None, metavar="SPEC_JSON",
+                   help="load the FULL workload (prompt lengths, arrival "
+                        "pattern, seeds, shared-prefix mix) from a committed "
+                        "spec file (configs/workloads/*.json) so tuning "
+                        "trials and bench runs replay byte-identical "
+                        "workloads across arms; the resolved spec's hash is "
+                        "embedded in the artifact (workload_hash)")
+    p.add_argument("--prompt-seed", type=int, default=1234,
+                   help="RNG seed for the deterministic prompt mix")
+    p.add_argument("--prompt-len-min", type=int, default=2,
+                   help="shortest prompt in the mixed workload")
+    p.add_argument("--prompt-len-max", type=int, default=8,
+                   help="longest prompt in the mixed workload (clamped to "
+                        "what the cache budget allows)")
     p.add_argument("--no-verify", action="store_true",
                    help="skip the per-request generate() parity check")
     p.add_argument("--obs-ab", action="store_true",
@@ -201,12 +215,54 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+# the workload-defining fields a --workload spec file may pin (anything
+# else in the file is an error — a typo must not silently change traffic)
+WORKLOAD_KEYS = (
+    "model", "requests", "concurrency", "mode", "rate", "max_new_tokens",
+    "cache_len", "seed", "prompt_seed", "prompt_len_min", "prompt_len_max",
+    "shared_prefix", "greedy",
+)
+
+
+def resolve_workload(args):
+    """Apply a --workload spec file onto args (the file is the frozen
+    source of truth for every traffic-defining field it names), then
+    return ``(name, spec, hash)`` for the RESOLVED workload — the spec
+    actually replayed, hashed so two artifacts claiming the same workload
+    can be checked byte-for-byte. Runs for every mode so the hash is
+    always available; the spec file itself is only meaningful for the
+    standard (engine-driving) scenario."""
+    name = "inline"
+    if args.workload:
+        raw = json.loads(Path(args.workload).read_text())
+        name = raw.pop("name", Path(args.workload).stem)
+        unknown = set(raw) - set(WORKLOAD_KEYS)
+        if unknown:
+            raise SystemExit(
+                f"workload spec {args.workload}: unknown keys "
+                f"{sorted(unknown)} (allowed: {sorted(WORKLOAD_KEYS)})"
+            )
+        for key, value in raw.items():
+            setattr(args, key, value)
+    spec = {k: getattr(args, k) for k in WORKLOAD_KEYS}
+    if args.shared_prefix:
+        # shared-prefix prompt construction derives the prefix length from
+        # the prefill chunk (make_requests), so for THAT workload the chunk
+        # is traffic-defining and must be part of the hashed identity —
+        # two different chunk sizes are two different request streams
+        spec["prefill_chunk_traffic"] = args.prefill_chunk
+    from zero_transformer_tpu.analysis.autotune import workload_hash
+
+    return name, spec, workload_hash(spec)
+
+
 def make_requests(args, vocab_size: int, cache_len: int):
     """Deterministic request mix: varied prompt lengths so admissions cross
     prefill buckets, seeds offset from --seed. With --shared-prefix, every
     prompt is one common system prefix (>= 2 prefill chunks when the cache
-    budget allows) + a short unique persona tail."""
-    rng = random.Random(1234)
+    budget allows) + a short unique persona tail. Every input comes from
+    args, so a --workload spec replays byte-identically across arms."""
+    rng = random.Random(args.prompt_seed)
     out = []
     if args.shared_prefix:
         chunk = max(1, args.prefill_chunk)
@@ -219,9 +275,10 @@ def make_requests(args, vocab_size: int, cache_len: int):
             tail = [rng.randint(1, vocab_size - 1) for _ in range(rng.randint(2, 4))]
             out.append((prefix + tail, args.seed + i))
         return out
-    max_prompt = max(2, min(8, cache_len - args.max_new_tokens))
+    max_prompt = max(2, min(args.prompt_len_max, cache_len - args.max_new_tokens))
+    min_prompt = max(1, min(args.prompt_len_min, max_prompt))
     for i in range(args.requests):
-        length = rng.randint(2, max_prompt)
+        length = rng.randint(min_prompt, max_prompt)
         prompt = [rng.randint(1, vocab_size - 1) for _ in range(length)]
         out.append((prompt, args.seed + i))
     return out
@@ -1206,6 +1263,15 @@ def main(argv=None) -> dict:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except RuntimeError:
             pass  # backend already initialized (e.g. under pytest)
+    if args.workload and (
+        args.router or args.long_prompt_flood or args.sawtooth
+        or args.capacity_sweep
+    ):
+        raise SystemExit(
+            "--workload pins the standard engine-driving workload; the "
+            "router/disagg/capacity scenarios generate their own traffic"
+        )
+    wl_name, wl_spec, wl_hash = resolve_workload(args)
     if args.router:
         if args.out == str(REPO / "BENCH_serve.json"):  # untouched default
             args.out = str(REPO / "BENCH_router.json")
@@ -1395,6 +1461,11 @@ def main(argv=None) -> dict:
         "model": args.model,
         "mode": args.mode,
         "workload": "shared_prefix" if args.shared_prefix else "mixed",
+        # the frozen traffic spec this run replayed (--workload file or the
+        # CLI-derived inline spec) — TUNE artifacts carry the same hash, so
+        # "tuned under this workload" is checkable, not asserted
+        "workload_spec": wl_name,
+        "workload_hash": wl_hash,
         "slots": args.slots,
         "requests": args.requests,
         "concurrency": min(args.concurrency, args.requests),

@@ -26,6 +26,9 @@ import json
 import sys
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+import bench_common  # noqa: E402  (shared skip-or-grade logic, ISSUE 14)
+
 TOLERANCE = 0.15
 
 
@@ -43,15 +46,12 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
         ]
     msgs.append(f"ok: parity bitwise over {parity.get('steps')} step(s)")
 
-    base_hw = (baseline.get("platform"), baseline.get("device_kind"))
-    fresh_hw = (fresh.get("platform"), fresh.get("device_kind"))
-    if None in base_hw or None in fresh_hw:
-        return ok, msgs + ["SKIP: an artifact lacks platform/device_kind"]
-    if base_hw != fresh_hw:
-        return ok, msgs + [
-            f"SKIP: hardware mismatch (baseline {base_hw} vs fresh "
-            f"{fresh_hw}); timing not comparable"
-        ]
+    hw_ok, hw_reason = bench_common.hardware_gate(
+        baseline, fresh, fields=("platform", "device_kind"),
+        what="timing not comparable",
+    )
+    if not hw_ok:
+        return ok, msgs + [hw_reason]
 
     base_ms = baseline.get("overlap_on", {}).get("step_ms", 0)
     fresh_ms = fresh.get("overlap_on", {}).get("step_ms", 0)
@@ -73,7 +73,8 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
             f"ok: overlap_on.step_ms {fresh_ms:.1f} (baseline {base_ms:.1f})"
         )
 
-    if baseline.get("provenance") == fresh.get("provenance"):
+    prov_ok, prov_reason = bench_common.provenance_gate(baseline, fresh)
+    if prov_ok:
         base_red = baseline.get("value", 0)
         fresh_red = fresh.get("value", 0)
         if base_red and fresh_red < base_red * (1 - tolerance):
@@ -89,10 +90,7 @@ def compare(baseline: dict, fresh: dict, tolerance: float = TOLERANCE):
                 f"(baseline {base_red:.2f}x, {fresh.get('provenance')})"
             )
     else:
-        msgs.append(
-            f"SKIP reduction: provenance changed "
-            f"({baseline.get('provenance')} -> {fresh.get('provenance')})"
-        )
+        msgs.append(prov_reason)
     return ok, msgs
 
 

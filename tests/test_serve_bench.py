@@ -24,6 +24,10 @@ REQUIRED_KEYS = {
     "chaos", "errors", "error_rate", "shed", "shed_rate",
     "drain_latency_s", "tick_faults", "poisoned_slots", "breaker_trips",
     "final_state",
+    # frozen-workload evidence (ISSUE 14): which spec this run replayed and
+    # its hash — TUNE artifacts carry the same hash, so "tuned under this
+    # workload" is checkable against the bench artifact
+    "workload_spec", "workload_hash",
     # serving hot path evidence (ISSUE 4): chunked prefill, prefix caching,
     # per-phase latency attribution, and the regression guard's keys
     "workload", "decode_tok_s", "prefill_chunk", "prefix_cache",

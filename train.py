@@ -28,24 +28,77 @@ def parse_overrides(pairs):
 
 
 def apply_overrides(cfg, overrides: dict):
-    # model.size applies FIRST (a zoo lookup replaces the whole model
-    # section), so model.* overrides — wherever they appear on the command
-    # line — land on top of the zoo entry instead of being clobbered by it
-    if "model.size" in overrides:
-        from zero_transformer_tpu.config import model_config
+    # one implementation with the autotuner's candidate-point construction:
+    # config.apply_dotted_overrides (model.size-first ordering included)
+    from zero_transformer_tpu.config import apply_dotted_overrides
 
-        cfg = dataclasses.replace(
-            cfg, model=model_config(str(overrides.pop("model.size")))
+    return apply_dotted_overrides(cfg, overrides)
+
+
+def _bench_common():
+    """scripts/bench_common.py via the shared by-path loader (the platform
+    gate the bench guards and both --tuned surfaces use)."""
+    from zero_transformer_tpu.utils.modload import load_script
+
+    return load_script("bench_common.py")
+
+
+# tuned-override couples (see scripts/autotune.py tuned_overrides): these
+# fields are only meaningful TOGETHER — accum microbatches the tuned
+# workload's fixed global batch, so batch_size rides with it. A user
+# override of either member drops the whole group, never leaving half a
+# pair applied (a stranded tuned batch_size would silently change the
+# global batch — exactly what the pairing exists to prevent).
+_COUPLED_TUNED_FIELDS = (
+    ("training.gradient_accumulation_steps", "training.batch_size"),
+)
+
+
+def apply_tuned(cfg, path, user_overrides, logger=None):
+    """Load a TUNE_train.json autotuner artifact (scripts/autotune.py) as
+    config defaults. The artifact only applies where it was measured: a
+    platform/model/target mismatch is REFUSED with a loud warning and the
+    hand defaults stand (the BENCH_ckpt_integrity/BENCH_step honesty
+    discipline — never silently apply foreign tuning). Explicit --set
+    overrides always win over tuned values."""
+    import logging
+
+    from zero_transformer_tpu.analysis.autotune import winner_overrides
+
+    logger = logger or logging.getLogger("zero_transformer_tpu")
+    bc = _bench_common()
+    artifact, reasons = bc.load_tuned(
+        path, platform=bc.platform_block(), model=cfg.model.name,
+        target="train",
+    )
+    if artifact is None:
+        logger.warning(
+            "--tuned %s REFUSED (%s); falling back to hand defaults",
+            path, "; ".join(reasons),
         )
-    for dotted, value in overrides.items():
-        section_name, _, field = dotted.partition(".")
-        section = getattr(cfg, section_name)
-        if not field or not hasattr(section, field):
-            raise ValueError(f"unknown config field {dotted!r}")
-        cfg = dataclasses.replace(
-            cfg, **{section_name: dataclasses.replace(section, **{field: value})}
-        )
-    return cfg
+        return cfg
+    overrides = {
+        k: v for k, v in winner_overrides(artifact).items()
+        if k not in user_overrides
+    }
+    for group in _COUPLED_TUNED_FIELDS:
+        if any(k in user_overrides for k in group):
+            dropped = [k for k in group if overrides.pop(k, None) is not None]
+            if dropped:
+                logger.warning(
+                    "--tuned %s: dropping coupled tuned fields %s — the "
+                    "user overrode %s and these only hold as a pair "
+                    "(fixed global batch)",
+                    path, dropped,
+                    [k for k in group if k in user_overrides],
+                )
+    logger.info(
+        "--tuned %s: applying autotuned defaults %s (tuned on %s, "
+        "workload %s, improvement %sx)",
+        path, overrides, artifact.get("platform"),
+        artifact.get("workload_hash"), artifact.get("value"),
+    )
+    return apply_overrides(cfg, overrides)
 
 
 def main():
@@ -127,6 +180,17 @@ def main():
         help="jax_debug_nans: fail fast at the op that produced a NaN "
         "(numeric sanitizer; ~2x slower — debugging only)",
     )
+    parser.add_argument(
+        "--tuned",
+        nargs="?",
+        const="TUNE_train.json",
+        default=None,
+        metavar="TUNE_JSON",
+        help="load autotuned defaults from a scripts/autotune.py artifact "
+        "(default: TUNE_train.json). Applied only when the artifact's "
+        "platform/model match this run — a mismatch is refused with a loud "
+        "warning and the hand defaults stand. --set overrides always win",
+    )
     # action="extend": repeated --set flags accumulate instead of the last
     # occurrence silently replacing earlier ones
     parser.add_argument(
@@ -146,7 +210,18 @@ def main():
     maybe_initialize()
 
     cfg = load_config(args.cfg)
-    cfg = apply_overrides(cfg, parse_overrides(args.set))
+    user_overrides = parse_overrides(args.set)
+    if args.tuned:
+        # a --set model.size zoo lookup applies BEFORE the tuned gate, so
+        # the artifact's model is checked against the model actually being
+        # trained — and the later full-override pass can no longer clobber
+        # tuned model.* values with a whole-section replacement
+        if "model.size" in user_overrides:
+            cfg = apply_overrides(
+                cfg, {"model.size": user_overrides.pop("model.size")}
+            )
+        cfg = apply_tuned(cfg, args.tuned, user_overrides)
+    cfg = apply_overrides(cfg, user_overrides)
     if args.resume:
         cfg = dataclasses.replace(
             cfg, checkpoint=dataclasses.replace(cfg.checkpoint, resume=True)

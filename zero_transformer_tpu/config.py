@@ -707,6 +707,47 @@ def load_config(path: str | Path, **overrides) -> Config:
     return Config(**sections)
 
 
+def apply_dotted_overrides(cfg: Config, overrides: dict[str, Any]) -> Config:
+    """Apply ``{"section.field": value}`` overrides to a Config, revalidating
+    every touched section (each ``dataclasses.replace`` re-runs the frozen
+    dataclass' ``__post_init__``). One implementation for ``train.py --set``
+    AND the autotuner's candidate-point construction
+    (``analysis/autotune.py``) — the validity oracle that refuses an invalid
+    knob combination is therefore exactly the validation a real run hits.
+
+    ``model.size`` applies FIRST (a zoo lookup replaces the whole model
+    section), so ``model.*`` overrides — wherever they appear — land on top
+    of the zoo entry instead of being clobbered by it.
+
+    All overrides for one section apply in a SINGLE ``replace`` so only the
+    final combination is validated — applying ``serving.prefill_chunk=8``
+    and ``serving.page_size=8`` one field at a time would refuse the valid
+    pair whenever the intermediate state (new chunk against the old page
+    size) happens to be invalid."""
+    overrides = dict(overrides)
+    if "model.size" in overrides:
+        cfg = dataclasses.replace(
+            cfg, model=model_config(str(overrides.pop("model.size")))
+        )
+    by_section: dict[str, dict[str, Any]] = {}
+    for dotted, value in overrides.items():
+        section_name, _, field = dotted.partition(".")
+        section = getattr(cfg, section_name, None)
+        if section is None or not field or not hasattr(section, field):
+            raise ValueError(f"unknown config field {dotted!r}")
+        by_section.setdefault(section_name, {})[field] = value
+    for section_name, fields in by_section.items():
+        cfg = dataclasses.replace(
+            cfg,
+            **{
+                section_name: dataclasses.replace(
+                    getattr(cfg, section_name), **fields
+                )
+            },
+        )
+    return cfg
+
+
 def flatten_config(cfg: Config) -> dict[str, Any]:
     """Flatten for metric loggers (reference ``src/utils/configs.py:7-17``)."""
     out = {}

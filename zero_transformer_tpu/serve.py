@@ -230,6 +230,70 @@ def _has_quantized_leaves(tree) -> bool:
     )
 
 
+# the ServingConfig knobs the autotuner searches (scripts/autotune.py):
+# argparse leaves them at a None sentinel so explicit flags are
+# distinguishable from "use the default"
+_TUNED_KNOBS = (
+    "kv_layout", "prefill_chunk", "page_size", "page_pool_tokens", "draft_k",
+)
+
+
+def _resolve_tuned_args(args):
+    """Resolve the autotuner-covered serving knobs in priority order:
+    explicit CLI flag > TUNE_serve.json winner (``--tuned``, gated) >
+    ServingConfig hand default. A tuned artifact whose platform/model do
+    not match THIS run is refused with a loud message and the hand
+    defaults stand — tuning is per (model, hardware, workload), never
+    portable by assumption."""
+    from zero_transformer_tpu.config import ServingConfig
+    from zero_transformer_tpu.utils.modload import load_script
+
+    defaults = ServingConfig()
+    tuned: dict = {}
+    if args.tuned:
+        bc = load_script("bench_common.py")
+        artifact, reasons = bc.load_tuned(
+            args.tuned, platform=bc.platform_block(), model=args.model,
+            target="serve",
+        )
+        if artifact is None:
+            print(
+                f"serve: --tuned {args.tuned} REFUSED "
+                f"({'; '.join(reasons)}); falling back to hand defaults",
+                flush=True,
+            )
+        else:
+            tuned = dict((artifact.get("winner") or {}).get("knobs") or {})
+            if tuned.get("draft_k") and args.repetition_penalty != 1.0:
+                # _server would disable speculation later with its generic
+                # flag-conflict message — the headline tuned knob must be
+                # dropped HERE instead, before the "applying tuned
+                # defaults" banner, with the artifact-aware remedy
+                print(
+                    f"serve: tuned draft_k={tuned['draft_k']} DROPPED: "
+                    f"--repetition-penalty {args.repetition_penalty} is "
+                    "incompatible with speculative verify; pass "
+                    "--repetition-penalty 1.0 to serve the tuned winner "
+                    "(the artifact's workload was measured without the "
+                    "penalty)",
+                    flush=True,
+                )
+                tuned.pop("draft_k")
+            print(
+                f"serve: --tuned {args.tuned}: autotuned defaults {tuned} "
+                f"(tuned on {artifact.get('platform')}, workload "
+                f"{artifact.get('workload_hash')}, "
+                f"{artifact.get('value')}x vs hand defaults)",
+                flush=True,
+            )
+    for name in _TUNED_KNOBS:
+        if getattr(args, name) is None:
+            setattr(args, name, tuned.get(name, getattr(defaults, name)))
+    if args.no_fused_tail is None:
+        args.no_fused_tail = not tuned.get("fused_tail", defaults.fused_tail)
+    return args
+
+
 def _build_generator(args) -> TextGenerator:
     from zero_transformer_tpu.checkpoint import import_params_msgpack
     from zero_transformer_tpu.config import model_config
@@ -449,7 +513,7 @@ def main(argv=None) -> None:
                         "under ZT_PALLAS_INTERPRET=1), XLA elsewhere; 'xla' "
                         "forces the reference path; 'flash' is flash-or-"
                         "raise (never silently O(T^2))")
-    p.add_argument("--no-fused-tail", action="store_true",
+    p.add_argument("--no-fused-tail", action="store_true", default=None,
                    help="A/B CONTROL: run sampling as its own dispatch "
                         "after the forward instead of inside the single "
                         "jitted decode program (byte-identical output; "
@@ -495,13 +559,25 @@ def main(argv=None) -> None:
     p.add_argument("--max-queue", type=int, default=serving_defaults.max_queue,
                    help="admission-queue depth; beyond it /generate "
                         "returns 429 (backpressure)")
+    p.add_argument("--tuned", nargs="?", const="TUNE_serve.json",
+                   default=None, metavar="TUNE_JSON",
+                   help="load autotuned serving defaults from a "
+                        "scripts/autotune.py artifact (default: "
+                        "TUNE_serve.json). Applied only when the artifact's "
+                        "platform/model match this run — a mismatch is "
+                        "refused with a loud warning and the hand defaults "
+                        "stand; explicit flags always win over tuned values")
+    # the autotuner-covered knobs default to None (sentinel): resolution is
+    # explicit flag > TUNE_serve.json winner (--tuned, gated) > the
+    # ServingConfig hand default — see _resolve_tuned_args
     p.add_argument("--prefill-chunk", type=int,
-                   default=serving_defaults.prefill_chunk,
+                   default=None,
                    help="prefill this many prompt tokens per scheduler tick, "
                         "written directly into the slot KV cache and "
                         "interleaved with decode — a long prompt no longer "
                         "stalls every active stream for its full prefill "
-                        "(0 = legacy one-shot bucketed prefill)")
+                        "(0 = legacy one-shot bucketed prefill; default "
+                        f"{serving_defaults.prefill_chunk})")
     p.add_argument("--prefix-cache", type=int,
                    default=serving_defaults.prefix_cache_chunks,
                    metavar="CHUNKS",
@@ -509,7 +585,7 @@ def main(argv=None) -> None:
                         "LRU: repeated system prompts skip straight to "
                         "their first novel chunk (0 = off; requires "
                         "--prefill-chunk > 0; flushed on hot reload)")
-    p.add_argument("--kv-layout", default=serving_defaults.kv_layout,
+    p.add_argument("--kv-layout", default=None,
                    choices=("slab", "paged"),
                    help="KV cache layout: 'paged' (default) = block-table "
                         "page pool (PagedAttention) — HBM scales with ACTUAL "
@@ -517,21 +593,22 @@ def main(argv=None) -> None:
                         "hits are page-refcount bumps; 'slab' = the classic "
                         "fixed [slots, cache_len] rows")
     p.add_argument("--page-size", type=int,
-                   default=serving_defaults.page_size,
+                   default=None,
                    help="tokens per KV page (paged layout); must divide "
-                        "--prefill-chunk and the cache length")
+                        "--prefill-chunk and the cache length (default "
+                        f"{serving_defaults.page_size})")
     p.add_argument("--page-pool-tokens", type=int,
-                   default=serving_defaults.page_pool_tokens,
+                   default=None,
                    help="total page-pool capacity in token positions "
                         "(0 = the slab-equivalent slots x cache_len); at a "
                         "fixed budget, more concurrent streams fit whenever "
                         "real sequences run shorter than cache_len")
-    p.add_argument("--draft-k", type=int, default=serving_defaults.draft_k,
+    p.add_argument("--draft-k", type=int, default=None,
                    help="speculative serving: verify K prompt-lookup draft "
                         "tokens per slot per tick in one batched forward "
                         "(greedy = bit-identical output, sampling = exact "
                         "rejection rule; needs --repetition-penalty 1.0; "
-                        "0 = off)")
+                        f"0 = off; default {serving_defaults.draft_k})")
     p.add_argument("--role", default=serving_defaults.role,
                    choices=("mixed", "prefill", "decode"),
                    help="disaggregated fleet role: 'prefill' runs only "
@@ -575,7 +652,7 @@ def main(argv=None) -> None:
                         "in-flight generations get this many seconds to "
                         "finish, then are force-finished and the process "
                         "exits 0")
-    args = p.parse_args(argv)
+    args = _resolve_tuned_args(p.parse_args(argv))
 
     gen = _build_generator(args)
     if args.server:

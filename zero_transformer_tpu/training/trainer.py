@@ -198,6 +198,7 @@ def _schedule_memory(
     pipeline stash formulas count what each engine's wavefront keeps live
     (GPipe/interleaved: the differentiated tick scan saves its carry once
     per tick; 1F1B: the hand-managed 2P-slot input ring)."""
+    from zero_transformer_tpu.analysis.memory import pp_stash_ticks
     from zero_transformer_tpu.config import resolve_dtype
     from zero_transformer_tpu.parallel.pipeline import bubble_fraction
 
@@ -218,11 +219,8 @@ def _schedule_memory(
     )
     out["microbatch_activation_bytes"] = act
     if P_ > 1:
-        stash_ticks = {
-            "gpipe": accum + P_ - 1,
-            "1f1b": 2 * P_,
-            "interleaved": V * accum + P_ - 1,
-        }[mc.pp_schedule]
+        # ONE formula table with the analytic pruner (analysis/memory.py)
+        stash_ticks = pp_stash_ticks(mc.pp_schedule, accum, P_, V)
         out["pp_activation_stash_bytes_est"] = stash_ticks * act
         if mc.pp_schedule == "interleaved":
             # interleaved stores the block stack pipe-replicated (see
@@ -283,6 +281,12 @@ def memory_analysis(cfg: Config, accum: Optional[int] = None) -> Dict[str, Any]:
         "tokens_per_step": accum * b.sample_shape[0] * b.sample_shape[1],
         "schedule": _schedule_memory(cfg, b, abstract, max(accum, 1)),
     }
+    # the compile-free analytic itemization (analysis/memory.py) rides
+    # along so one report carries both the compiled ground truth and the
+    # numbers the autotuner's pruner would see for this point
+    from zero_transformer_tpu.analysis.memory import analytic_memory
+
+    out["analytic"] = analytic_memory(cfg, accum=accum)
     try:
         ma = compiled.memory_analysis()
         out.update(

@@ -1,0 +1,32 @@
+"""Load repo scripts as modules by file location — one implementation.
+
+The ``scripts/`` directory is not a package (its files are CLIs loaded by
+path from tests, guards, and the ``--tuned`` surfaces); every consumer
+used to hand-roll the ``spec_from_file_location`` boilerplate. Any future
+fix to the loading pattern (sys.modules registration, error handling for
+a missing scripts dir) now lands once, here.
+"""
+from __future__ import annotations
+
+import importlib.util
+from pathlib import Path
+
+# the repo root this package is installed/checked out under
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def load_module(name: str, path):
+    """Exec the file at ``path`` as module ``name`` and return it."""
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load {name} from {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_script(filename: str):
+    """A module from ``<repo>/scripts/<filename>`` (e.g. the shared
+    ``bench_common.py`` provenance gate both --tuned surfaces use)."""
+    return load_module(filename.rsplit(".", 1)[0],
+                       REPO_ROOT / "scripts" / filename)
