@@ -73,18 +73,23 @@ router-chaos:
 disagg-chaos:
 	$(PY) -m pytest tests/test_serving_disagg.py -q -m chaos $(PYTEST_ARGS)
 
-# Observability lane (ISSUE 7): the obs test file (span-tree parity over
-# every request outcome, Prometheus exposition conformance under live
-# traffic, X-Request-Id round trip, flight-recorder dump on breaker-open,
-# /admin/profile lifecycle) plus a loadgen trace smoke — one small run must
-# produce a Perfetto-loadable span trace with nonzero events.
+# Observability lane (ISSUE 7 + ISSUE 15): the obs test files (span-tree
+# parity over every request outcome, Prometheus exposition conformance
+# under live traffic, X-Request-Id round trip, flight-recorder dump on
+# breaker-open, /admin/profile lifecycle, fleet stitching/aggregation/SLO/
+# ledger) plus two smokes: a loadgen trace smoke (one small run must
+# produce a Perfetto-loadable span trace with nonzero events) and the
+# stub-fleet stitched-trace smoke (router + 2 stub replicas -> ONE merged
+# fleet trace, programmatically verified: >=95% coverage, zero orphans,
+# rollup sums pinned, /slo verdict ok).
 obs:
-	$(PY) -m pytest tests/test_obs.py -q $(PYTEST_ARGS)
+	$(PY) -m pytest tests/test_obs.py tests/test_fleet_obs.py -q $(PYTEST_ARGS)
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --requests 4 --slots 2 \
 		--max-new-tokens 8 --cache-len 64 --out /tmp/_obs_smoke.json
 	$(PY) -c "import json; t=json.load(open('/tmp/_obs_smoke.trace.json')); \
 		n=len(t['traceEvents']); assert n, 'empty trace'; \
 		print(f'obs trace smoke ok: {n} events')"
+	$(PY) scripts/fleet_obs_smoke.py
 
 # One-line JSON benchmark artifact (driver contract).
 bench:

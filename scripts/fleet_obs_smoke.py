@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Stub-fleet stitched-trace smoke (``make obs``, PR 15).
+
+Spins up a router over 2 paced stub replicas (stdlib-only — no jax, no
+model), drives a handful of streams, and then verifies the fleet
+observability plane end to end, programmatically:
+
+- ONE merged Perfetto trace per request (router relay spans + each stub's
+  request tree on its own process track, clock-offset corrected): >= 95%
+  wall-latency coverage, zero orphan spans, hop ordering intact;
+- the router's /metrics exposes ``fleet_*`` rollups whose per-role sums
+  equal the per-replica scrapes they fold;
+- ``/slo`` answers with the declared objectives' burn rates and an ``ok``
+  verdict on this healthy run;
+- every terminal event carried a complete cost ledger (schema-pinned).
+
+Writes the merged trace artifact to ``--out`` (default
+``/tmp/_fleet_obs_smoke.trace.json``) and exits nonzero on any failure.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from zero_transformer_tpu.obs.fleet import (  # noqa: E402
+    FLEET_OBS_REQUIRED_KEYS,
+    parse_exposition,
+    request_ids_in,
+    verify_stitched,
+)
+from zero_transformer_tpu.serving.router import RouterServer  # noqa: E402
+
+
+def _load_stubs():
+    spec = importlib.util.spec_from_file_location(
+        "serve_router", REPO / "scripts" / "serve_router.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _sse(port: int, body: dict):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("POST", "/generate", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        done = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data: "):
+                continue
+            event = json.loads(line[6:])
+            if event.get("done"):
+                done = event
+                break
+        return done
+    finally:
+        conn.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default="/tmp/_fleet_obs_smoke.trace.json")
+    p.add_argument("--streams", type=int, default=4)
+    p.add_argument("--itl-ms", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    serve_router = _load_stubs()
+    stubs = [
+        serve_router.StubReplica(itl_s=args.itl_ms / 1e3, slots=2).start()
+        for _ in range(2)
+    ]
+    router = RouterServer(
+        [s.url for s in stubs], probe_interval=0.05, chunk_tokens=4,
+        metrics_scrape_interval=0.1, slo_eval_interval=0.1,
+    )
+    router.start()
+    failures: list = []
+    try:
+        if not router.wait_ready(10.0):
+            raise SystemExit("FLEET OBS SMOKE FAILED: fleet never ready")
+
+        dones: list = []
+        lock = threading.Lock()
+
+        def client(i):
+            done = _sse(router.port, {
+                "tokens": [5 + i] * 4, "max_new_tokens": 8,
+            })
+            with lock:
+                dones.append(done)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(args.streams)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if len(dones) != args.streams or any(
+            d is None or d.get("status") != "done" for d in dones
+        ):
+            failures.append(f"streams did not all finish: {dones}")
+
+        # --- ledger schema on every terminal event
+        for d in dones:
+            missing = FLEET_OBS_REQUIRED_KEYS["ledger"] - set(
+                (d or {}).get("ledger") or {}
+            )
+            if missing:
+                failures.append(f"ledger missing keys: {sorted(missing)}")
+                break
+
+        # --- merged trace, one route root per stream, verified
+        doc = router.merged_trace()
+        rids = request_ids_in(doc)
+        if len(rids) != args.streams:
+            failures.append(
+                f"expected {args.streams} stitched requests, got {len(rids)}"
+            )
+        worst = 1.0
+        for rid in rids:
+            check = verify_stitched(doc, rid, slack_s=0.05)
+            worst = min(worst, check["coverage"])
+            if check["orphans"] or not check["hops_ordered"]:
+                failures.append(f"stitch check failed for {rid}: {check}")
+        if worst < 0.95:
+            failures.append(f"stitched coverage {worst:.3f} < 0.95")
+        Path(args.out).write_text(json.dumps(doc) + "\n")
+
+        # --- fleet rollups: per-role sums equal the per-replica scrapes
+        router.scrape_fleet_metrics()
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", router.port, timeout=10)
+        conn.request("GET", "/metrics?format=prometheus")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        fams = parse_exposition(text)
+        fleet_tokens = sum(
+            v for labels, v in fams.get(
+                "fleet_serve_tokens_out_total", {"samples": []}
+            )["samples"] if "replica" not in labels
+        )
+        stub_tokens = sum(s.tokens_emitted for s in stubs)
+        if fleet_tokens != stub_tokens:
+            failures.append(
+                f"fleet rollup {fleet_tokens} != per-replica sum {stub_tokens}"
+            )
+
+        # --- /slo verdict on a healthy run
+        router.evaluate_slo()
+        conn = http.client.HTTPConnection("127.0.0.1", router.port, timeout=10)
+        conn.request("GET", "/slo")
+        slo = json.loads(conn.getresponse().read())
+        conn.close()
+        missing = FLEET_OBS_REQUIRED_KEYS["slo"] - set(slo)
+        if missing:
+            failures.append(f"/slo missing keys: {sorted(missing)}")
+        if slo.get("verdict") != "ok":
+            failures.append(f"healthy run's SLO verdict: {slo.get('verdict')}")
+        if router.stats["dropped_streams"]:
+            failures.append("dropped streams during the smoke")
+    finally:
+        router.stop()
+        for s in stubs:
+            s.stop()
+    if failures:
+        print("FLEET OBS SMOKE FAILED: " + "; ".join(failures))
+        return 1
+    print(
+        f"fleet obs smoke ok: {args.streams} streams stitched "
+        f"(min coverage {worst:.3f}), rollups pinned, SLO verdict ok -> "
+        f"{args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,12 +87,38 @@ def compare_router(
     if not reload_block.get("ok") or reload_block.get("dropped_streams"):
         ok = False
         msgs.append(f"FAIL: rolling reload {reload_block}")
+    # stitched-trace verification (ISSUE 15) is correctness: a merged trace
+    # with orphan spans or <95% coverage is a broken observability plane on
+    # any hardware (absent block = pre-PR15 artifact, skipped not failed)
+    trace_block = fresh.get("fleet_trace")
+    if trace_block is not None:
+        if trace_block.get("coverage_min", 0) < 0.95:
+            ok = False
+            msgs.append(
+                f"FAIL: stitched-trace coverage "
+                f"{trace_block.get('coverage_min')} < 0.95"
+            )
+        if trace_block.get("orphans") or not trace_block.get("hops_ordered"):
+            ok = False
+            msgs.append(f"FAIL: stitched trace {trace_block}")
     if not grade_scaling:
         msgs.append(
             "SKIP: hardware mismatch vs baseline; router scaling ratio "
             "not graded (correctness fields were)"
         )
         return ok, msgs
+    # the SLO verdict (ISSUE 15) grades with the perf numbers: on foreign
+    # hardware a "violated" verdict may be the box, not the router — but on
+    # matching hardware the declared objectives are part of the bar
+    slo = fresh.get("slo") or {}
+    if slo.get("verdict") == "violated":
+        ok = False
+        msgs.append(
+            f"REGRESSION: SLO verdict violated — "
+            f"{ {name: o.get('state') for name, o in (slo.get('objectives') or {}).items() if o.get('state') != 'ok'} }"
+        )
+    elif slo:
+        msgs.append(f"ok: SLO verdict {slo.get('verdict')}")
     ratio = fresh.get("value", 0)
     if ratio < ROUTER_SCALING_MIN:
         ok = False

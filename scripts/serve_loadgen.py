@@ -663,14 +663,14 @@ def run_router_bench(args) -> dict:
     dropped_total = 0
     failures: list = []
 
-    def fleet(n, **kw):
+    def fleet(n, router_kw=None, **kw):
         stubs = [
             serve_router.StubReplica(itl_s=itl_s, slots=slots, **kw).start()
             for _ in range(n)
         ]
         router = RouterServer(
             [s.url for s in stubs], probe_interval=0.05, chunk_tokens=chunk,
-            max_attempts=4, stream_timeout=60.0,
+            max_attempts=4, stream_timeout=60.0, **(router_kw or {}),
         )
         router.start()
         if not router.wait_ready(10.0):
@@ -733,6 +733,72 @@ def run_router_bench(args) -> dict:
                 "affinity_misses": snap["affinity_misses"],
                 "hit_rate": round(snap["affinity_hit_rate"], 4),
             }
+
+    # ---- segment 1.5: fleet observability plane (ISSUE 15) — an
+    # unsaturated 2-replica fleet with the SLO engine on: every stream's
+    # merged fleet trace must stitch (>=95% coverage, zero orphans, hops
+    # ordered after clock correction), the terminal ledgers must be
+    # schema-complete, and the healthy run's SLO verdict must be ok
+    from zero_transformer_tpu.obs.fleet import FLEET_OBS_REQUIRED_KEYS
+    from zero_transformer_tpu.obs.slo import Objective
+
+    trace_path = (
+        args.out[:-5] if args.out.endswith(".json") else args.out
+    ) + ".trace.json"
+    obs_objectives = [
+        # correctness-shaped objectives for the verdict: latency SLOs on a
+        # deliberately saturated CPU-box sweep would grade queue wait, not
+        # the router (tests/test_fleet_obs.py exercises the latency path)
+        Objective(name="availability", metric="availability", target=0.999,
+                  short_window_s=5.0, long_window_s=60.0),
+        Objective(name="dropped_streams", metric="dropped_streams",
+                  kind="zero", target=0.999999, short_window_s=5.0,
+                  long_window_s=60.0, fast_burn=1.0),
+    ]
+    stubs, router = fleet(2, router_kw={
+        "slo": obs_objectives, "metrics_scrape_interval": 0.1,
+        "slo_eval_interval": 0.1,
+    })
+    fleet_trace = {"file": Path(trace_path).name}
+    slo_block: dict = {}
+    ledger_block: dict = {}
+    try:
+        wall, tokens, done_n, mismatches, hung = _drive_router_fleet(
+            router, prefixes[: min(4, len(prefixes))], 1, max_new,
+            expect_base=1000,
+        )
+        if hung or mismatches:
+            failures.append(
+                f"fleet-obs segment: {hung} hung, {mismatches} mismatches"
+            )
+        router.scrape_fleet_metrics()
+        router.evaluate_slo()
+        stitch = router.verify_run_traces()
+        router.export_merged_trace(trace_path)
+        fleet_trace.update({
+            k: stitch[k]
+            for k in ("requests", "coverage_min", "orphans", "hops_ordered")
+        })
+        slo_block = router.slo.snapshot()
+        ledger_block = router.tenants.totals()
+        if stitch["coverage_min"] < 0.95:
+            failures.append(
+                f"stitched coverage {stitch['coverage_min']} < 0.95"
+            )
+        if stitch["orphans"] or not stitch["hops_ordered"]:
+            failures.append(f"stitched trace failed verification: {stitch}")
+        if slo_block.get("verdict") != "ok":
+            failures.append(
+                f"healthy fleet-obs segment SLO verdict: "
+                f"{slo_block.get('verdict')}"
+            )
+        missing_led = FLEET_OBS_REQUIRED_KEYS["ledger"] - set(ledger_block)
+        if missing_led:
+            failures.append(f"aggregate ledger missing {sorted(missing_led)}")
+        if not ledger_block.get("tokens_relayed"):
+            failures.append("aggregate ledger relayed no tokens")
+    finally:
+        teardown(stubs, router)
 
     # ---- segment 2: mid-stream failover on a survivor, token-exact
     victim = serve_router.StubReplica(
@@ -826,6 +892,13 @@ def run_router_bench(args) -> dict:
         "failover": failover,
         "rolling_reload": reload_result,
         "dropped_streams": dropped_total,
+        # fleet observability plane (ISSUE 15): the merged fleet trace's
+        # programmatic verification, the SLO verdict over the run, and the
+        # aggregate cost ledger (serve_bench_guard fails a violated verdict
+        # on matching hardware)
+        "fleet_trace": fleet_trace,
+        "slo": slo_block,
+        "ledger": ledger_block,
         "platform": _platform_block(),
         "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

@@ -77,6 +77,16 @@ class StubReplica:
         self._sem = threading.Semaphore(slots)
         self._lock = threading.Lock()
         self._die_after = die_after_tokens
+        # fleet-obs surface (PR 15), stdlib-only like the rest of the stub:
+        # a bounded per-request span list (the router's /admin/spans pull),
+        # and fixed-bucket TTFT samples for the /metrics exposition the
+        # router's aggregator folds
+        self._spans: list = []  # dicts: track/name/t0/t1/attrs
+        self._span_cap = 4096
+        self._ttft_buckets = (0.005, 0.025, 0.1, 0.5, 2.0)
+        self._ttft_counts = [0] * (len(self._ttft_buckets) + 1)
+        self._ttft_sum = 0.0
+        self._ttft_n = 0
         # pre-stream server errors: the first N /generate requests answer
         # 500 before any SSE bytes (a crashed handler, not a dead process)
         self._fail_5xx = fail_5xx_requests
@@ -110,13 +120,44 @@ class StubReplica:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
-                if self.path.partition("?")[0] != "/healthz":
+                path, _, query = self.path.partition("?")
+                if path == "/admin/spans":
+                    rid = ""
+                    for part in query.split("&"):
+                        if part.startswith("request_id="):
+                            rid = part[len("request_id="):]
+                    with outer._lock:
+                        spans = [
+                            s for s in outer._spans
+                            if not rid or s["track"] == rid
+                        ]
+                    self._json(200, {
+                        "request_id": rid,
+                        "clock_monotonic": time.monotonic(),
+                        "role": "mixed",
+                        "spans": spans,
+                        "spans_dropped": 0,
+                    })
+                    return
+                if path == "/metrics":
+                    body = outer._metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path != "/healthz":
                     self._json(404, {"error": "no route"})
                     return
                 ok = outer.state == "ready"
                 self._json(200 if ok else 503, {
                     "status": "ok" if ok else outer.state,
                     "state": outer.state,
+                    "clock_monotonic": time.monotonic(),
                     "uptime_s": round(time.monotonic() - outer._born, 3),
                     "reloads": outer.reloads,
                     "breaker_open": False,
@@ -176,8 +217,69 @@ class StubReplica:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    def _add_span(self, track, name, t0, t1, attrs=None) -> None:
+        with self._lock:
+            if len(self._spans) >= self._span_cap:
+                del self._spans[: self._span_cap // 4]
+            self._spans.append({
+                "track": str(track), "name": name, "t0": t0, "t1": t1,
+                "attrs": attrs,
+            })
+
+    def _observe_ttft(self, ttft_s: float) -> None:
+        with self._lock:
+            i = len(self._ttft_buckets)
+            for j, bound in enumerate(self._ttft_buckets):
+                if ttft_s <= bound:
+                    i = j
+                    break
+            self._ttft_counts[i] += 1
+            self._ttft_sum += ttft_s
+            self._ttft_n += 1
+
+    def _metrics_text(self) -> str:
+        """Minimal 0.0.4 exposition so the router's fleet aggregator (and
+        its latency SLO objectives) have real families to fold — the same
+        names the real replica exports."""
+        with self._lock:
+            counts = list(self._ttft_counts)
+            total, s = self._ttft_n, self._ttft_sum
+            tokens = self.tokens_emitted
+            requests = self.requests
+            active = self.active
+            queued = self.waiting
+        lines = [
+            "# HELP serve_tokens_out_total Tokens emitted to clients",
+            "# TYPE serve_tokens_out_total counter",
+            f"serve_tokens_out_total {tokens}",
+            "# HELP serve_submitted_total Requests submitted",
+            "# TYPE serve_submitted_total counter",
+            f"serve_submitted_total {requests}",
+            "# HELP serve_queue_depth Requests waiting for a slot",
+            "# TYPE serve_queue_depth gauge",
+            f"serve_queue_depth {queued}",
+            "# HELP serve_slot_occupancy Slots actively decoding",
+            "# TYPE serve_slot_occupancy gauge",
+            f"serve_slot_occupancy {active}",
+            "# HELP serve_ttft_seconds Submit-to-first-token latency",
+            "# TYPE serve_ttft_seconds histogram",
+        ]
+        cum = 0
+        for bound, c in zip(self._ttft_buckets, counts):
+            cum += c
+            lines.append(f'serve_ttft_seconds_bucket{{le="{bound}"}} {cum}')
+        lines.append(f'serve_ttft_seconds_bucket{{le="+Inf"}} {total}')
+        lines.append(f"serve_ttft_seconds_sum {s:.6f}")
+        lines.append(f"serve_ttft_seconds_count {total}")
+        return "\n".join(lines) + "\n"
+
     def _generate(self, handler, req: dict) -> None:
         rid = handler.headers.get("X-Request-Id") or req.get("request_id")
+        try:
+            hop = int(handler.headers.get("X-Trace-Hop", ""))
+        except (TypeError, ValueError):
+            hop = None
+        t_req = time.monotonic()
         with self._lock:
             self.requests += 1
             self.seen_request_ids.append(rid)
@@ -195,9 +297,29 @@ class StubReplica:
                 return
             self.waiting += 1
         self._sem.acquire()
+        t_acq = time.monotonic()
         with self._lock:
             self.waiting -= 1
             self.active += 1
+
+        def ledger(n_tokens: int, now: float) -> dict:
+            return {
+                "decode_ticks": n_tokens, "tokens_out": n_tokens,
+                "prefill_chunks": 1, "migrations": 0,
+                "queue_ms": round((t_acq - t_req) * 1e3, 3),
+                "prefill_ms": 0.0,
+                "decode_ms": round((now - t_acq) * 1e3, 3),
+            }
+
+        def emit_spans(now: float, n_tokens: int, outcome: str) -> None:
+            if rid:
+                attrs = {"outcome": outcome, "tokens": n_tokens}
+                if hop is not None:
+                    attrs["hop"] = hop
+                self._add_span(rid, "request", t_req, now, attrs)
+                self._add_span(rid, "queue", t_req, t_acq)
+                self._add_span(rid, "decode", t_acq, now)
+
         try:
             prompt = req.get("tokens") or [0] * len(str(req.get("prompt", "x")))
             max_new = int(req.get("max_new_tokens", 8))
@@ -207,16 +329,21 @@ class StubReplica:
             if not stream:
                 with self._lock:
                     self.tokens_emitted += len(ids)
+                now = time.monotonic()
+                self._observe_ttft(t_acq - t_req + self.itl_s)
+                emit_spans(now, len(ids), "done")
                 handler._json(200, {
                     "status": "done", "tokens": ids,
                     "text": "".join(f"<{t}>" for t in ids),
                     "request_id": rid,
+                    "ledger": ledger(len(ids), now),
                 })
                 return
             handler.send_response(200)
             handler.send_header("Content-Type", "text/event-stream")
             handler.end_headers()
             sent = []
+            first_at = None
             for t in ids:
                 time.sleep(self.itl_s)
                 with self._lock:
@@ -243,6 +370,9 @@ class StubReplica:
                     handler.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     return  # client (router) went away; stop decoding
+                if first_at is None:
+                    first_at = time.monotonic()
+                    self._observe_ttft(first_at - t_req)
                 sent.append(t)
                 with self._lock:
                     self.tokens_emitted += 1
@@ -262,9 +392,12 @@ class StubReplica:
                 except OSError:
                     pass
                 return
+            now = time.monotonic()
+            emit_spans(now, len(sent), "done")
             done = {"done": True, "status": "done",
                     "text": "".join(f"<{t}>" for t in sent),
-                    "retryable": False, "request_id": rid}
+                    "retryable": False, "request_id": rid,
+                    "ledger": ledger(len(sent), now)}
             try:
                 handler.wfile.write(
                     b"data: " + json.dumps(done).encode() + b"\n\n"
@@ -386,6 +519,14 @@ def main(argv=None) -> None:
     p.add_argument("--admin-token", default=None)
     p.add_argument("--obs-dir", default=None,
                    help="flight-recorder dumps (replica ejections) + traces")
+    p.add_argument("--slo", default=None, metavar="SPEC_JSON",
+                   help="SLO objectives config (JSON list — see "
+                        "configs/slo_default.json); 'off' disables the SLO "
+                        "engine; default: the built-in objectives")
+    p.add_argument("--metrics-scrape-interval", type=float, default=1.0,
+                   help="seconds between per-replica /metrics scrapes "
+                        "folded into the router's fleet_* rollups "
+                        "(0 disables aggregation + SLO evaluation)")
     p.add_argument("--disaggregate", default="auto",
                    choices=("auto", "off"),
                    help="split requests prefill/decode by phase whenever the "
@@ -433,6 +574,12 @@ def main(argv=None) -> None:
         p.error("router mode needs at least one --replica URL")
     from zero_transformer_tpu.serving.router import run_router
 
+    slo = None  # None -> the built-in default objectives
+    if args.slo == "off":
+        slo = ()
+    elif args.slo:
+        slo = json.loads(Path(args.slo).read_text())
+
     run_router(
         args.replica, host=args.host, port=args.port,
         probe_interval=args.probe_interval, probe_timeout=args.probe_timeout,
@@ -444,6 +591,8 @@ def main(argv=None) -> None:
         obs_dir=args.obs_dir,
         disaggregate=args.disaggregate,
         migrate_drain=not args.no_migrate_drain,
+        slo=slo,
+        metrics_scrape_interval=args.metrics_scrape_interval,
     )
 
 

@@ -62,6 +62,9 @@ ROUTER_REQUIRED_KEYS = {
     "replica_slots", "clients", "requests_per_client", "max_new_tokens",
     "scaling", "aggregate_tok_s", "routing", "failover", "rolling_reload",
     "dropped_streams", "platform", "measured_at_utc",
+    # fleet observability plane (ISSUE 15): the merged-trace verification,
+    # the SLO verdict over the run, and the aggregate cost ledger
+    "fleet_trace", "slo", "ledger",
 }
 
 DISAGG_REQUIRED_KEYS = {"bench", "metric", "platform", "config", "flood",
@@ -324,6 +327,21 @@ def test_loadgen_router_artifact(tmp_path):
     assert artifact["rolling_reload"]["dropped_streams"] == 0
     assert artifact["dropped_streams"] == 0
     assert set(artifact["platform"]) == {"backend", "device"}
+    # fleet observability plane (ISSUE 15): the merged trace stitched and
+    # verified, the SLO verdict ok on a healthy run, and the aggregate
+    # ledger schema-complete (FLEET_OBS_REQUIRED_KEYS is the contract)
+    from zero_transformer_tpu.obs.fleet import FLEET_OBS_REQUIRED_KEYS
+
+    ft = artifact["fleet_trace"]
+    assert ft["coverage_min"] >= 0.95 and ft["orphans"] == 0
+    assert ft["hops_ordered"] is True and ft["requests"] >= 1
+    trace_doc = json.loads((out.parent / ft["file"]).read_text())
+    assert trace_doc["traceEvents"], "merged fleet trace is empty"
+    assert FLEET_OBS_REQUIRED_KEYS["slo"] <= set(artifact["slo"])
+    assert artifact["slo"]["verdict"] == "ok"
+    missing = FLEET_OBS_REQUIRED_KEYS["ledger"] - set(artifact["ledger"])
+    assert not missing, f"aggregate ledger missing {sorted(missing)}"
+    assert artifact["ledger"]["tokens_relayed"] > 0
 
 
 def test_committed_disagg_artifact_schema():
@@ -458,8 +476,39 @@ def test_serve_bench_guard_router_logic():
         "failover": {"token_exact": True, "resumed_streams": 1},
         "rolling_reload": {"ok": True, "steps": 3, "dropped_streams": 0},
         "platform": {"backend": "cpu", "device": "x"},
+        "fleet_trace": {"coverage_min": 0.99, "orphans": 0,
+                        "hops_ordered": True, "requests": 4},
+        "slo": {"verdict": "ok", "objectives": {}},
     }
     ok, _ = guard.compare(good, dict(good))
+    assert ok
+    # an SLO verdict of violated fails on matching hardware (ISSUE 15)...
+    bad_slo = {**good, "slo": {"verdict": "violated", "objectives": {
+        "availability": {"state": "fast_burn"}}}}
+    ok, msgs = guard.compare(good, bad_slo)
+    assert not ok and any("SLO" in m for m in msgs)
+    # ...but skips with the other perf grades across a hardware mismatch
+    ok, msgs = guard.compare(
+        good, {**bad_slo, "platform": {"backend": "tpu", "device": "v4"}}
+    )
+    assert ok and any("SKIP" in m for m in msgs)
+    # a broken stitched trace is correctness — fails anywhere
+    ok, msgs = guard.compare(good, {
+        **good, "platform": {"backend": "tpu", "device": "v4"},
+        "fleet_trace": {"coverage_min": 0.5, "orphans": 0,
+                        "hops_ordered": True},
+    })
+    assert not ok and any("coverage" in m for m in msgs)
+    ok, msgs = guard.compare(good, {
+        **good,
+        "fleet_trace": {"coverage_min": 0.99, "orphans": 2,
+                        "hops_ordered": True},
+    })
+    assert not ok and any("stitched" in m for m in msgs)
+    # pre-PR15 artifacts (no fleet_trace/slo blocks) still grade cleanly
+    legacy = {k: v for k, v in good.items()
+              if k not in ("fleet_trace", "slo")}
+    ok, _ = guard.compare(legacy, dict(legacy))
     assert ok
     # below the absolute near-linear bar fails on matching hardware
     ok, msgs = guard.compare(good, {**good, "value": 2.4})

@@ -25,6 +25,21 @@ See docs/OBSERVABILITY.md for the span model, metric tables, and scrape
 configuration.
 """
 from zero_transformer_tpu.obs.exporter import MetricsExporter
+from zero_transformer_tpu.obs.fleet import (
+    ENGINE_LEDGER_KEYS,
+    FLEET_OBS_REQUIRED_KEYS,
+    LEDGER_KEYS,
+    ROUTER_LEDGER_KEYS,
+    FleetAggregator,
+    TenantLedger,
+    complete_ledger,
+    estimate_clock_offset,
+    new_engine_ledger,
+    parse_exposition,
+    request_ids_in,
+    stitch_spans,
+    verify_stitched,
+)
 from zero_transformer_tpu.obs.flight import FlightRecorder
 from zero_transformer_tpu.obs.logging import (
     MetricsLogger,
@@ -44,25 +59,50 @@ from zero_transformer_tpu.obs.metrics import (
     Registry,
 )
 from zero_transformer_tpu.obs.profiling import ProfileWindow, parse_profile_window
+from zero_transformer_tpu.obs.slo import (
+    Objective,
+    SLOEngine,
+    default_objectives,
+    parse_slo_config,
+)
 from zero_transformer_tpu.obs.spans import (
     Tracer,
     coverage_fraction,
+    span_dict,
     span_tree,
 )
 
 __all__ = [
     "Counter",
+    "ENGINE_LEDGER_KEYS",
+    "FLEET_OBS_REQUIRED_KEYS",
+    "FleetAggregator",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "LATENCY_BUCKETS",
+    "LEDGER_KEYS",
     "MetricsExporter",
     "MetricsLogger",
+    "Objective",
     "ProfileWindow",
+    "ROUTER_LEDGER_KEYS",
     "Registry",
+    "SLOEngine",
     "StepTimer",
+    "TenantLedger",
     "Tracer",
+    "complete_ledger",
     "coverage_fraction",
+    "default_objectives",
+    "estimate_clock_offset",
+    "new_engine_ledger",
+    "parse_exposition",
+    "parse_slo_config",
+    "request_ids_in",
+    "span_dict",
+    "stitch_spans",
+    "verify_stitched",
     "device_peak_flops",
     "hbm_device_stats",
     "hbm_used_gb",

@@ -38,11 +38,17 @@ class FlightRecorder:
         tracer=None,
         clock=time.monotonic,
         span_tail: int = 2000,
+        max_dumps: int = 64,
     ):
         self.directory = str(directory) if directory else None
         self.tracer = tracer
         self.clock = clock
         self.span_tail = span_tail
+        # dump-directory rotation: breaker flaps / repeated ejections / SLO
+        # burns each write a dump, and a long-lived replica must not grow
+        # flightrec/ without bound — past max_dumps the OLDEST dump this
+        # recorder wrote is deleted (the newest always survives)
+        self.max_dumps = max(1, int(max_dumps))
         self._ticks: deque = deque(maxlen=capacity)
         self._events: deque = deque(maxlen=capacity)
         self._n_dumps = 0
@@ -107,6 +113,12 @@ class FlightRecorder:
             log.exception("flight recorder: dump for %r failed (continuing)", reason)
             return None
         self.dumps.append(str(path))
+        while len(self.dumps) > self.max_dumps:
+            oldest = self.dumps.pop(0)
+            try:
+                Path(oldest).unlink()
+            except OSError:
+                pass  # already gone / permissions: rotation is best-effort
         log.warning("flight recorder: dumped %d ticks / %d events to %s "
                     "(reason: %s)", len(doc["ticks"]), len(doc["events"]),
                     path, reason)

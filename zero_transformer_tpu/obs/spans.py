@@ -29,11 +29,14 @@ from __future__ import annotations
 import contextlib
 import itertools
 import json
+import logging
 import threading
 import time
 from collections import deque
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger("zero_transformer_tpu")
 
 # span record layout (fixed tuple, index-addressed):
 # (seq, track, name, t0_s, t1_s, attrs_or_None)
@@ -55,6 +58,12 @@ class Tracer:
         self._ring: deque = deque(maxlen=capacity)
         self._seq = itertools.count()
         self._added = 0
+        self._capacity = capacity
+        # warn ONCE at first overflow: the drop count is exported on
+        # /metrics (obs_spans_dropped), but an operator reading logs must
+        # also learn that trace truncation started — silently losing the
+        # head of every trace is the failure mode this flag makes loud
+        self._overflow_warned = False
         # JSONL cursor: seq of the last span already flushed to disk
         self._flushed_seq = -1
 
@@ -83,6 +92,13 @@ class Tracer:
             return
         self._ring.append((next(self._seq), track, name, t0, t1, attrs))
         self._added += 1
+        if self._added > self._capacity and not self._overflow_warned:
+            self._overflow_warned = True
+            log.warning(
+                "tracer: span ring overflowed (capacity %d) — oldest spans "
+                "are being dropped; obs_spans_dropped counts them on "
+                "/metrics", self._capacity,
+            )
 
     def instant(self, name: str, track: str, t: Optional[float] = None,
                 attrs: Optional[Dict[str, Any]] = None) -> None:
@@ -114,6 +130,15 @@ class Tracer:
 
     def by_track(self, track: str) -> List[tuple]:
         return [s for s in self._ring if s[TRACK] == track]
+
+    def track_dicts(self, track: Optional[str] = None,
+                    tail: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Spans as JSON-ready dicts (the /admin/spans wire shape and the
+        stitching input): one track's spans, or the whole ring tail."""
+        spans = self.by_track(track) if track is not None else self.spans()
+        if tail is not None:
+            spans = spans[-tail:]
+        return [span_dict(s) for s in spans]
 
     # --------------------------------------------------------------- export
 
@@ -186,6 +211,14 @@ class Tracer:
                 }) + "\n")
         self._flushed_seq = fresh[-1][SEQ]
         return len(fresh)
+
+
+def span_dict(s: tuple) -> Dict[str, Any]:
+    """One ring record as the cross-process wire/stitch shape."""
+    return {
+        "track": s[TRACK], "name": s[NAME], "t0": s[T0], "t1": s[T1],
+        "attrs": s[ATTRS],
+    }
 
 
 def span_tree(spans: List[tuple], track: str) -> Dict[str, Any]:

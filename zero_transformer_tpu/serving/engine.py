@@ -240,6 +240,24 @@ class RequestHandle:
         # the engine's Tracer; the lifecycle span tree is emitted from the
         # timestamps above in ONE batch at _finish (zero per-token cost)
         self._tracer: Optional[Tracer] = None
+        # per-request cost ledger (obs/fleet.py PR 15): plain-int counters
+        # the tick thread bumps — prefill chunks, decode ticks, drafted/
+        # accepted tokens, pages held x ticks. Rides the page-span payload
+        # on migration so the counts stay CUMULATIVE across replicas; the
+        # ms split is computed from the lifecycle timestamps at read time,
+        # with _ledger_ms_base carrying the milliseconds already spent on
+        # earlier hops of a migrated stream.
+        self.ledger: Dict[str, int] = {
+            "prefill_chunks": 0, "decode_ticks": 0, "tokens_out": 0,
+            "draft_tokens": 0, "accepted_tokens": 0, "pages_held_ticks": 0,
+            "migrations": 0,
+        }
+        self._ledger_ms_base = {"queue_ms": 0.0, "prefill_ms": 0.0,
+                                "decode_ms": 0.0}
+        # propagated trace context: the router's hop index for this
+        # dispatch (span attrs carry it so the stitched fleet trace can
+        # assert hop ordering across processes)
+        self.trace_hop: Optional[int] = None
         self._events: queue_mod.Queue = queue_mod.Queue()
         self._done = threading.Event()
         self._cancel = threading.Event()
@@ -284,6 +302,38 @@ class RequestHandle:
             raise TimeoutError(f"request {self.id} still {self.status}")
         return list(self.tokens)
 
+    def ledger_snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The request's cost ledger as the terminal event reports it:
+        cumulative counters plus the queue/prefill/decode millisecond
+        split from the lifecycle timestamps (hop-local wall added to the
+        base a migrated stream carried in). ``now`` lets a LIVE snapshot
+        (the migration export) account wall time up to this instant —
+        without it a mid-decode hop would ship decode_ms=0 and the
+        cumulative split would silently lose the source hop's time."""
+        sub = self.submitted_at
+        adm = self.admitted_at
+        pre = self.prefill_done_at
+        fin = self.finished_at
+        if fin is not None:
+            end = fin
+        elif now is not None:
+            end = now
+        else:
+            end = pre or adm or sub
+        queue_ms = ((adm if adm is not None else end) - sub) * 1e3
+        prefill_ms = (
+            ((pre if pre is not None else end) - adm) * 1e3
+            if adm is not None else 0.0
+        )
+        decode_ms = (end - pre) * 1e3 if pre is not None else 0.0
+        base = self._ledger_ms_base
+        return {
+            **{k: int(v) for k, v in self.ledger.items()},
+            "queue_ms": round(base["queue_ms"] + max(0.0, queue_ms), 3),
+            "prefill_ms": round(base["prefill_ms"] + max(0.0, prefill_ms), 3),
+            "decode_ms": round(base["decode_ms"] + max(0.0, decode_ms), 3),
+        }
+
     # -- scheduler side ----------------------------------------------------
 
     def _emit(self, token: int, now: float) -> None:
@@ -322,6 +372,10 @@ class RequestHandle:
         sub, adm, pre = self.submitted_at, self.admitted_at, self.prefill_done_at
         attrs = {"id": self.rid, "outcome": self.status,
                  "tokens": len(self.tokens)}
+        if self.trace_hop is not None:
+            # propagated trace context: the stitched fleet trace asserts
+            # hop ordering on this attr after clock-offset correction
+            attrs["hop"] = self.trace_hop
         if self.error:
             attrs["error"] = self.error
         tr.add("request", self.rid, sub, fin, attrs)
@@ -1190,6 +1244,7 @@ class ServingEngine:
         timeout: Optional[float] = None,
         request_id: Optional[str] = None,
         prefill_to: Optional[str] = None,
+        trace_hop: Optional[int] = None,
     ) -> RequestHandle:
         """Enqueue a request; returns its handle immediately.
 
@@ -1198,7 +1253,9 @@ class ServingEngine:
         ``rejected`` (callers map that to HTTP 429 / 400) — the error string
         says which. ``request_id`` threads an inbound correlation id
         (``X-Request-Id``) through the span tree and response; omitted, one
-        is generated here at admission.
+        is generated here at admission. ``trace_hop`` is the router's hop
+        index for this dispatch (``X-Trace-Hop``) — recorded on the span
+        tree so the stitched fleet trace can order hops across processes.
         """
         now = self.now()
         if timeout is not None:
@@ -1209,6 +1266,7 @@ class ServingEngine:
         )
         handle = RequestHandle(request, next(self._ids), now, request_id=request_id)
         handle._tracer = self.tracer
+        handle.trace_hop = trace_hop
         invalid = self._validate(request)
         with self._lock:
             if self._dead is not None:
@@ -1636,6 +1694,10 @@ class ServingEngine:
         self.stats["prefill_chunks"] += sum(active)
         completed = []
         for slot, job in self._prefilling.items():
+            if active[slot]:
+                # ledger attribution: this request paid for one chunk row
+                # of the batched dispatch (sums to stats["prefill_chunks"])
+                job.handle.ledger["prefill_chunks"] += 1
             job.fill = min(starts[slot] + C, lens[slot])
             if job.fill >= lens[slot]:
                 completed.append((slot, job))
@@ -2038,10 +2100,19 @@ class ServingEngine:
         ttft_new: List[float] = []
         itl_new: List[float] = []
         tokens_before = self.stats["tokens_out"]
+        paged_ledger = self.kv_layout == "paged"
         for slot, act in enumerate(self._active):
             if act is None:
                 continue
             toks = blocks[slot][: n_emits[slot]]
+            # cost ledger: one decode tick held, at this slot's current KV
+            # page footprint (pages x ticks is the capacity-time integral a
+            # tenant actually consumed; slab slots have no page unit — 0)
+            act.handle.ledger["decode_ticks"] += 1
+            if paged_ledger:
+                act.handle.ledger["pages_held_ticks"] += (
+                    self.slots.alloc_blocks[slot]
+                )
             if act.emitted == 0:
                 ttft_new.append(now - act.handle.submitted_at)
             elif act.last_emit_at is not None:
@@ -2062,6 +2133,7 @@ class ServingEngine:
                 act.emitted += 1
                 act.last_emit_at = now
                 self.stats["tokens_out"] += 1
+                act.handle.ledger["tokens_out"] += 1
                 hit_eos = (
                     self.eos_token_id is not None and int(t) == self.eos_token_id
                 )
@@ -2182,9 +2254,12 @@ class ServingEngine:
             if not active[slot]:
                 continue
             self.stats["draft_tokens"] += K
+            ledger = self._active[slot].handle.ledger
+            ledger["draft_tokens"] += K
             if not bool(bad_rows[slot]):
                 acc = int(n_accs[slot])
                 self.stats["accepted_tokens"] += acc
+                ledger["accepted_tokens"] += acc
                 n_emits[slot] = 1 + acc
         return blocks, n_emits, bad_rows
 
@@ -2320,6 +2395,12 @@ class ServingEngine:
             "seed": int(req.seed),
             "deadline_s": deadline_s,
             "draft_k": self.draft_k,
+            # cost-ledger carry: counters + the ms already spent here (the
+            # handle is still LIVE, so wall time accrues to now), so the
+            # destination's terminal event reports the CUMULATIVE cost of
+            # the whole stream, not just its final hop
+            "ledger": handle.ledger_snapshot(now=self.now()),
+            "hop": handle.trace_hop,
         }
 
     # graftlint: hot-path
@@ -2512,6 +2593,7 @@ class ServingEngine:
             request_id=payload.get("request_id"),
         )
         handle._tracer = self.tracer
+        self._seed_imported_ledger(handle, payload)
         if self.role == "prefill":
             handle._finish(
                 REJECTED, now,
@@ -2562,6 +2644,34 @@ class ServingEngine:
                 return handle
             self._pending_imports.append((handle, payload))
         return handle
+
+    @staticmethod
+    def _seed_imported_ledger(handle: RequestHandle, payload: Dict[str, Any]) -> None:
+        """Continue the shipped stream's cumulative cost ledger: counters
+        carry over verbatim, the source's ms split becomes this handle's
+        base, and the page crossing itself counts as one migration.
+        Defensive coercion — a version-skewed peer's ledger must degrade
+        to zeros, never fault the import."""
+        led = payload.get("ledger")
+        if isinstance(led, dict):
+            for key in handle.ledger:
+                try:
+                    handle.ledger[key] = int(led.get(key, 0) or 0)
+                except (TypeError, ValueError):
+                    pass
+            for key in handle._ledger_ms_base:
+                try:
+                    handle._ledger_ms_base[key] = float(led.get(key, 0.0) or 0.0)
+                except (TypeError, ValueError):
+                    pass
+        handle.ledger["migrations"] += 1
+        hop = payload.get("hop")
+        if hop is not None:
+            try:
+                # the attach dispatch is the NEXT hop after the ship
+                handle.trace_hop = int(hop) + 1
+            except (TypeError, ValueError):
+                pass
 
     # graftlint: hot-path
     def _service_imports(self) -> None:
@@ -3313,6 +3423,14 @@ class ServingEngine:
         reg.gauge_func(
             "serve_trace_spans_dropped",
             "Spans pushed out of the bounded trace ring",
+            lambda: self.tracer.dropped,
+        )
+        # the fleet-standard name (PR 15): same value on every process
+        # (router, replicas, trainer exporter) so one dashboard query
+        # covers trace-truncation honesty fleet-wide
+        reg.gauge_func(
+            "obs_spans_dropped",
+            "Spans dropped by ring overflow (trace truncation honesty)",
             lambda: self.tracer.dropped,
         )
         # per-device HBM with max/mean rollups (None on backends without

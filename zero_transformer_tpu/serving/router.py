@@ -70,7 +70,22 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 from urllib.parse import urlsplit
 
 from zero_transformer_tpu.obs.flight import FlightRecorder
+from zero_transformer_tpu.obs.fleet import (
+    FleetAggregator,
+    TenantLedger,
+    complete_ledger,
+    estimate_clock_offset,
+    request_ids_in,
+    stitch_spans,
+    verify_stitched,
+)
 from zero_transformer_tpu.obs.metrics import Registry
+from zero_transformer_tpu.obs.slo import (
+    Objective,
+    SLOEngine,
+    default_objectives,
+    parse_slo_config,
+)
 from zero_transformer_tpu.obs.spans import Tracer
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
@@ -140,6 +155,14 @@ class Replica:
     # every ship, so placement filters on it up front.
     kv_layout: str = ""
     draft_k: int = 0
+    # per-process clock offset (replica monotonic clock minus the router's,
+    # PR 15): estimated NTP-style from each probe's round trip against the
+    # ``clock_monotonic`` the /healthz body carries; the trace stitcher
+    # subtracts it to place this replica's spans on the fleet timeline.
+    # rtt is the error bar (the true offset is within ±rtt/2).
+    clock_offset_s: float = 0.0
+    clock_rtt_s: float = float("inf")
+    clock_at: float = 0.0
 
     @property
     def importable(self) -> bool:
@@ -222,6 +245,7 @@ class ReplicaRegistry:
         ok: bool,
         code: Optional[int] = None,
         body: Optional[dict] = None,
+        rtt_window: Optional[Tuple[float, float]] = None,
     ) -> List[Tuple[str, str]]:
         """Fold one probe outcome into the replica's state. ``ok`` means the
         probe got an HTTP response with a parseable body (whatever the
@@ -230,6 +254,14 @@ class ReplicaRegistry:
         ``("ejected", rid)`` / ``("recovered", rid)``."""
         now = self.clock()
         events: List[Tuple[str, str]] = []
+        # parse the remote clock OUTSIDE the lock (lint: no conversions of
+        # foreign values while holding the registry lock)
+        clock_remote: Optional[float] = None
+        if body is not None and body.get("clock_monotonic") is not None:
+            try:
+                clock_remote = float(body["clock_monotonic"])
+            except (TypeError, ValueError):
+                clock_remote = None
         with self._lock:
             r = self.replicas.get(rid)
             if r is None:
@@ -271,6 +303,20 @@ class ReplicaRegistry:
                     r.cow_copies = int(body.get("cow_copies", 0) or 0)
                     r.kv_layout = str(body.get("kv_layout", "") or "")
                     r.draft_k = int(body.get("draft_k", 0) or 0)
+                    if rtt_window is not None and clock_remote is not None:
+                        # per-process clock offset from this round trip
+                        # (keeps the tighter-rtt estimate until it ages)
+                        prev = (
+                            None if r.clock_rtt_s == float("inf")
+                            else (r.clock_offset_s, r.clock_rtt_s, r.clock_at)
+                        )
+                        r.clock_offset_s, r.clock_rtt_s, r.clock_at = (
+                            estimate_clock_offset(
+                                clock_remote,
+                                rtt_window[0], rtt_window[1],
+                                prev=prev, now=now,
+                            )
+                        )
                 r.next_probe_at = now + self.probe_interval
             else:
                 r.consecutive_failures += 1
@@ -392,6 +438,11 @@ class ReplicaRegistry:
                     "active_relays": r.active_relays,
                     "tokens_relayed": r.tokens_relayed,
                     "requests_routed": r.requests_routed,
+                    "clock_offset_s": r.clock_offset_s,
+                    "clock_rtt_s": (
+                        r.clock_rtt_s
+                        if r.clock_rtt_s != float("inf") else None
+                    ),
                 }
                 for r in self.replicas.values()
             }
@@ -588,6 +639,10 @@ class RouterServer:
         scale_down_active: int = 0,
         scale_patience: int = 3,
         scale_drain_timeout_s: float = 15.0,
+        metrics_scrape_interval: float = 1.0,
+        slo: Optional[Sequence] = None,
+        slo_eval_interval: float = 0.5,
+        tenant_ledger_capacity: int = 1024,
     ):
         self.clock = clock
         self.probe_timeout = probe_timeout
@@ -666,6 +721,11 @@ class RouterServer:
             "autoscale_ups": 0,
             "autoscale_downs": 0,
             "autoscale_aborts": 0,
+            # fleet observability plane (PR 15)
+            "metrics_scrapes": 0,
+            "slo_evaluations": 0,
+            "slo_fast_burns": 0,
+            "stitched_traces": 0,
         }
         # handler threads bump stats concurrently; += on a dict entry is a
         # read-modify-write, so every increment goes through _bump
@@ -675,6 +735,20 @@ class RouterServer:
         self.metrics = Registry()
         self.flight = FlightRecorder(
             directory=self.obs_dir, tracer=self.tracer, clock=clock
+        )
+        # fleet observability plane (PR 15): the per-replica /metrics
+        # scrapes fold into fleet_* rollups, terminal-event cost ledgers
+        # roll up per tenant, and the SLO engine evaluates declared
+        # objectives over the aggregated streams on the obs loop
+        self.metrics_scrape_interval = float(metrics_scrape_interval)
+        self.aggregator = FleetAggregator()
+        self.tenants = TenantLedger(capacity=tenant_ledger_capacity)
+        self.slo_eval_interval = float(slo_eval_interval)
+        self.slo = self._build_slo(slo)
+        self._slo_hot = False  # fast-burn up-signal the autoscaler consumes
+        self._slo_lock = threading.Lock()
+        self._obs_thread = threading.Thread(
+            target=self._obs_loop, name="router-obs", daemon=True
         )
         self._register_exports()
         self._stop = threading.Event()
@@ -705,6 +779,16 @@ class RouterServer:
                 path, _, query = self.path.partition("?")
                 if path == "/healthz":
                     self._json(*outer._healthz())
+                elif path == "/slo":
+                    # the declared objectives' verdict: budget remaining +
+                    # burn rate per objective over the aggregated streams
+                    self._json(200, outer.slo_snapshot())
+                elif path == "/admin/trace":
+                    if not outer._admin_allowed(self):
+                        self._json(403, {"error": "admin endpoint: loopback "
+                                                  "or bearer token required"})
+                        return
+                    self._json(*outer._admin_trace(query))
                 elif path == "/metrics":
                     accept = self.headers.get("Accept") or ""
                     if (
@@ -712,7 +796,12 @@ class RouterServer:
                         or "text/plain" in accept
                         or "openmetrics" in accept
                     ):
-                        body = outer.metrics.render().encode()
+                        # router-local families + the fleet_* rollups the
+                        # aggregator folded from the per-replica scrapes:
+                        # ONE scrape sees the whole fleet
+                        body = (
+                            outer.metrics.render() + outer.aggregator.render()
+                        ).encode()
                         self.send_response(200)
                         self.send_header(
                             "Content-Type",
@@ -777,6 +866,8 @@ class RouterServer:
     def start(self, probe: bool = True) -> None:
         if probe and not self._probe_thread.ident:
             self._probe_thread.start()
+        if probe and self._obs_enabled() and not self._obs_thread.ident:
+            self._obs_thread.start()
         if self._autoscale_enabled() and not self._autoscale_thread.ident:
             self._autoscale_thread.start()
         self._server_thread = threading.Thread(
@@ -787,6 +878,8 @@ class RouterServer:
     def serve_forever(self) -> None:
         if not self._probe_thread.ident:
             self._probe_thread.start()
+        if self._obs_enabled() and not self._obs_thread.ident:
+            self._obs_thread.start()
         if self._autoscale_enabled() and not self._autoscale_thread.ident:
             self._autoscale_thread.start()
         try:
@@ -826,6 +919,7 @@ class RouterServer:
         self._bump("probes")
         ok, code, body = False, None, None
         conn = None
+        t0 = self.clock()
         try:
             conn = http.client.HTTPConnection(
                 rep.host, rep.port, timeout=self.probe_timeout
@@ -840,9 +934,13 @@ class RouterServer:
         finally:
             if conn is not None:
                 conn.close()
+        t1 = self.clock()
         if not ok:
             self._bump("probe_failures")
-        self._registry_events(self.registry.observe_probe(rid, ok, code, body))
+        self._registry_events(
+            self.registry.observe_probe(rid, ok, code, body,
+                                        rtt_window=(t0, t1))
+        )
         return ok
 
     def _registry_events(self, events: List[Tuple[str, str]]) -> None:
@@ -860,6 +958,271 @@ class RouterServer:
             elif name == "recovered":
                 self._bump("recoveries")
                 self.flight.event("replica_recovered", replica=rid)
+
+    # ---------------------------------------------- fleet observability plane
+
+    def _obs_enabled(self) -> bool:
+        return self.metrics_scrape_interval > 0
+
+    def _obs_loop(self) -> None:
+        """Scrape every routable replica's /metrics into the aggregator,
+        then evaluate the SLO engine over the fresh rollups — one loop so
+        an evaluation never reads half-updated aggregates."""
+        last_eval = 0.0
+        while not self._stop.wait(self.metrics_scrape_interval):
+            try:
+                self.scrape_fleet_metrics()
+                now = self.clock()
+                if self.slo is not None and (
+                    now - last_eval >= self.slo_eval_interval
+                ):
+                    last_eval = now
+                    self.evaluate_slo()
+            except Exception:  # noqa: BLE001 — the obs loop must outlive any one bad scrape
+                self.flight.event("obs_loop_error")
+
+    def scrape_fleet_metrics(self) -> int:
+        """One aggregation pass: GET /metrics (Prometheus text) from every
+        routable replica, fold into the aggregator, and drop replicas that
+        left the registry. Returns how many scrapes landed."""
+        live = {r.id: r for r in self.registry.routable()}
+        for rid in self.aggregator.replicas():
+            if rid not in self.registry.replicas:
+                self.aggregator.drop(rid)
+        landed = 0
+        for rid, rep in live.items():
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=self.probe_timeout
+                )
+                conn.request(
+                    "GET", "/metrics?format=prometheus",
+                    headers={"Accept": "text/plain;version=0.0.4"},
+                )
+                resp = conn.getresponse()
+                text = resp.read().decode("utf-8", "replace")
+                if resp.status == 200:
+                    self.aggregator.update(rid, rep.role, text)
+                    landed += 1
+            except (OSError, http.client.HTTPException):
+                pass  # probe failures own reachability; a missed scrape just ages the rollup
+            finally:
+                if conn is not None:
+                    conn.close()
+        if landed:
+            self._bump("metrics_scrapes", landed)
+        return landed
+
+    def _build_slo(self, spec) -> Optional[SLOEngine]:
+        """The SLO engine from declared objectives: None/default list,
+        dicts (config file shape), or ready Objective instances. An empty
+        sequence disables SLO evaluation."""
+        if spec is None:
+            objectives = default_objectives()
+        elif not spec:
+            return None
+        elif all(isinstance(o, Objective) for o in spec):
+            objectives = list(spec)
+        else:
+            objectives = parse_slo_config(list(spec))
+        engine = SLOEngine(clock=self.clock)
+        for obj in objectives:
+            engine.add_objective(obj, self._bind_slo_source(obj))
+        engine.on_fast_burn(self._on_slo_fast_burn)
+        return engine
+
+    def _bind_slo_source(self, obj: Objective):
+        """(bad, total) cumulative source for one declared metric: latency
+        objectives read the fleet-merged histograms (aggregated streams),
+        availability and dropped_streams read the router's own counters."""
+        if obj.metric == "ttft_p99":
+            return lambda: self._latency_source(
+                "serve_ttft_seconds", obj.threshold_s
+            )
+        if obj.metric == "itl_p99":
+            return lambda: self._latency_source(
+                "serve_itl_seconds", obj.threshold_s
+            )
+        if obj.metric == "availability":
+            def availability():
+                with self._stats_lock:
+                    total = self.stats["requests"]
+                    bad = self.stats["rejected_no_replica"]
+                return (bad, total)
+            return availability
+        if obj.metric == "dropped_streams":
+            def dropped():
+                with self._stats_lock:
+                    return (self.stats["dropped_streams"],
+                            max(1, self.stats["streams"]))
+            return dropped
+        raise ValueError(f"no source for SLO metric {obj.metric!r}")
+
+    def _latency_source(self, family: str, threshold_s: float):
+        gt = self.aggregator.good_total_below(family, threshold_s)
+        if gt is None:
+            return None  # no replica scrape yet; the objective waits
+        good, total = gt
+        return (total - good, total)
+
+    def evaluate_slo(self) -> Dict[str, Any]:
+        """One SLO evaluation over the current aggregates (the obs loop's
+        cadence; tests call it directly). Returns the /slo payload."""
+        if self.slo is None:
+            return {"objectives": {}, "verdict": "disabled", "evaluated": 0,
+                    "window_clipped": True}
+        self._bump("slo_evaluations")
+        return self.slo.evaluate()
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        if self.slo is None:
+            return {"objectives": {}, "verdict": "disabled", "evaluated": 0,
+                    "window_clipped": True}
+        return self.slo.snapshot()
+
+    def _on_slo_fast_burn(self, obj: Objective, snap: Dict[str, Any]) -> None:
+        """Fast burn = the error budget dies in hours: fire the EXISTING
+        machinery — a flight-recorder dump with the fleet snapshot (the
+        3am post-mortem), an event the autoscaler consumes as an up-signal
+        on its next tick, and the engine's own loud log."""
+        self._bump("slo_fast_burns")
+        with self._slo_lock:
+            self._slo_hot = True
+        self.flight.event("slo_fast_burn", objective=obj.name, **{
+            k: v for k, v in snap.items() if not isinstance(v, dict)
+        })
+        self.flight.dump(
+            f"slo_fast_burn_{obj.name}",
+            extra={
+                "objective": obj.name,
+                "snapshot": snap,
+                "registry": self.registry.snapshot(),
+                "slo": self.slo.snapshot() if self.slo else {},
+            },
+        )
+
+    def consume_slo_hot(self) -> bool:
+        """Autoscaler side of the up-signal: reads AND clears the flag so
+        one burn episode contributes one round of up-pressure."""
+        with self._slo_lock:
+            hot, self._slo_hot = self._slo_hot, False
+        return hot
+
+    # ---- cross-process trace stitching
+
+    def fetch_replica_spans(
+        self, rep: Replica, request_id: Optional[str] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """One replica's span tail (GET /admin/spans) — None when the
+        replica is unreachable or does not serve spans (a stub fleet
+        member mid-upgrade): stitching degrades to fewer tracks, never
+        fails the request."""
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.probe_timeout
+            )
+            path = "/admin/spans"
+            if request_id:
+                path += f"?request_id={request_id}"
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return None
+            doc = json.loads(body or b"{}")
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            if conn is not None:
+                conn.close()
+
+    def merged_trace(self, request_id: Optional[str] = None) -> Dict[str, Any]:
+        """ONE Perfetto document for a request (or the whole recent window
+        with ``request_id=None``): the router's spans as the reference
+        track plus every reachable replica's span tail, each replica's
+        timestamps corrected by its probe-estimated clock offset onto the
+        router clock, one pid per process. This is the artifact that makes
+        a disaggregated request's latency readable — router, prefill,
+        ship, decode, and attach hops on separate tracks of one timeline."""
+        groups: List[Dict[str, Any]] = [{
+            "process": "router",
+            "offset_s": 0.0,
+            "spans": self.tracer.track_dicts(track=request_id),
+        }]
+        for rep in list(self.registry.replicas.values()):
+            doc = self.fetch_replica_spans(rep, request_id)
+            if doc is None:
+                continue
+            spans = doc.get("spans") or []
+            if not spans:
+                continue
+            groups.append({
+                "process": f"{doc.get('role', rep.role)}:{rep.id}",
+                "offset_s": rep.clock_offset_s,
+                "spans": spans,
+            })
+        self._bump("stitched_traces")
+        merged = stitch_spans(groups)
+        if request_id:
+            merged["otherData"]["request_id"] = request_id
+            merged["otherData"]["stitch"] = verify_stitched(
+                merged, request_id, slack_s=self._stitch_slack_s()
+            )
+        return merged
+
+    def _stitch_slack_s(self) -> float:
+        """Orphan/ordering tolerance for stitched traces: the clock-offset
+        error bar is rtt/2 per replica — use the worst live estimate,
+        floored at 50 ms (scheduler jitter on loaded boxes)."""
+        rtts = [
+            r.clock_rtt_s for r in self.registry.replicas.values()
+            if r.clock_rtt_s != float("inf")
+        ]
+        return max(0.05, max(rtts) / 2.0 if rtts else 0.0)
+
+    def export_merged_trace(
+        self, path: str, request_id: Optional[str] = None,
+    ) -> str:
+        from zero_transformer_tpu.obs.fleet import write_trace
+
+        return write_trace(path, self.merged_trace(request_id))
+
+    def verify_run_traces(self) -> Dict[str, Any]:
+        """Per-run stitched-trace verification: one merged doc for the
+        whole recent window, then every request id with a ``route`` root
+        checked for coverage / orphans / hop order. The loadgen embeds
+        this block in BENCH_router.json."""
+        doc = self.merged_trace()
+        slack = self._stitch_slack_s()
+        rids = request_ids_in(doc)
+        checks = {
+            rid: verify_stitched(doc, rid, slack_s=slack) for rid in rids
+        }
+        return {
+            "requests": len(rids),
+            "coverage_min": min(
+                (c["coverage"] for c in checks.values()), default=0.0
+            ),
+            "orphans": sum(c["orphans"] for c in checks.values()),
+            "hops_ordered": all(
+                c["hops_ordered"] for c in checks.values()
+            ) if checks else False,
+            "per_request": checks,
+        }
+
+    def _admin_trace(self, query: str):
+        """(code, body) for GET /admin/trace?request_id=<rid>: the merged
+        fleet trace (Perfetto JSON) for one request, stitch verification
+        included in otherData."""
+        from urllib.parse import parse_qs
+
+        rid = (parse_qs(query).get("request_id") or [None])[0]
+        if not rid:
+            return 400, {"error": "request_id is required"}
+        return 200, self.merged_trace(_clean_rid(rid))
 
     # --------------------------------------------------------------- routing
 
@@ -951,9 +1314,13 @@ class RouterServer:
         )
         self.registry.inc_relay(P.id)
         hop0 = self.clock()
+        hop_idx = state.get("hops", 0)
+        state["hops"] = hop_idx + 1
+        state.setdefault("replica_ids", []).append(P.id)
         status: Optional[int] = None
         try:
-            status, doc = self._post_replica(P, "/generate", body, rid=rid)
+            status, doc = self._post_replica(P, "/generate", body, rid=rid,
+                                             hop=hop_idx)
         except (OSError, http.client.HTTPException) as exc:
             self._registry_events(
                 self.registry.observe_relay_failure(P.id, str(exc))
@@ -962,7 +1329,7 @@ class RouterServer:
         finally:
             self.registry.dec_relay(P.id)
             self.tracer.add("relay", rid, hop0, self.clock(), {
-                "replica": P.id, "mode": "prefill",
+                "replica": P.id, "mode": "prefill", "hop": hop_idx,
                 "status": status if status is not None else "dead",
             })
         if status == 200 and doc.get("status") == "migrated" and doc.get(
@@ -982,7 +1349,7 @@ class RouterServer:
         )
 
     def _attach_collect(
-        self, url: str, rid: str
+        self, url: str, rid: str, hop: int = 0
     ) -> Tuple[List[int], Optional[dict]]:
         """Attach to an imported stream and collect it wholesale (the JSON
         non-stream path's tail of a migrated request)."""
@@ -992,7 +1359,8 @@ class RouterServer:
             conn = self._connect(rep)
             conn.request(
                 "POST", "/attach", json.dumps({"request_id": rid}),
-                {"Content-Type": "application/json", "X-Request-Id": rid},
+                {"Content-Type": "application/json", "X-Request-Id": rid,
+                 "X-Trace-Hop": str(hop)},
             )
             resp = conn.getresponse()
             if resp.status != 200:
@@ -1056,6 +1424,11 @@ class RouterServer:
             snap["affinity_hits"] / aff_total if aff_total else 0.0
         )
         snap["replicas"] = self.registry.snapshot()
+        snap["tenants"] = self.tenants.snapshot()
+        snap["slo_verdict"] = (
+            self.slo.snapshot()["verdict"] if self.slo is not None
+            else "disabled"
+        )
         return snap
 
     def _register_exports(self) -> None:
@@ -1089,6 +1462,10 @@ class RouterServer:
             ("autoscale_ups", "Replicas spawned by the autoscaler"),
             ("autoscale_downs", "Replicas retired by the autoscaler"),
             ("autoscale_aborts", "Scale-downs aborted over undrainable streams"),
+            ("metrics_scrapes", "Per-replica /metrics scrapes folded into the fleet rollups"),
+            ("slo_evaluations", "SLO engine evaluation passes"),
+            ("slo_fast_burns", "SLO fast-burn escalations fired"),
+            ("stitched_traces", "Merged fleet traces assembled"),
         ):
             reg.counter_func(
                 f"router_{key}", help_text, (lambda k=key: self.stats[k])
@@ -1097,6 +1474,70 @@ class RouterServer:
             "router_routable_replicas", "Replicas currently in rotation",
             lambda: len(self.registry.routable()),
         )
+        # bounded-ring honesty, fleet-standard name (PR 15 satellite): the
+        # router's own trace truncation is as silent-failure-prone as a
+        # replica's
+        reg.gauge_func(
+            "obs_spans_dropped",
+            "Spans dropped by ring overflow (trace truncation honesty)",
+            lambda: self.tracer.dropped,
+        )
+        # SLO engine exposition: one labeled series per declared objective
+        # (values read from the last evaluation — a scrape never triggers
+        # an evaluation of its own)
+
+        def slo_rows(field: str):
+            if self.slo is None:
+                return []
+            snap = self.slo.snapshot()
+            return [
+                ({"objective": name}, obj[field])
+                for name, obj in sorted(snap["objectives"].items())
+            ]
+
+        reg.gauge_func(
+            "slo_budget_remaining",
+            "Error budget remaining per objective (1 = untouched)",
+            lambda: slo_rows("budget_remaining"),
+        )
+        reg.gauge_func(
+            "slo_burn_rate_short",
+            "Burn rate over the objective's short window",
+            lambda: slo_rows("burn_rate_short"),
+        )
+        reg.gauge_func(
+            "slo_burn_rate_long",
+            "Burn rate over the objective's long window",
+            lambda: slo_rows("burn_rate_long"),
+        )
+        reg.gauge_func(
+            "slo_fast_burn",
+            "1 while the objective is fast-burning",
+            lambda: [
+                (labels, 1 if state == "fast_burn" else 0)
+                for labels, state in slo_rows("state")
+            ],
+        )
+        reg.gauge_func(
+            "slo_violated",
+            "1 while any objective is burning or out of budget",
+            lambda: (
+                1 if self.slo is not None
+                and self.slo.snapshot()["verdict"] == "violated" else 0
+            ),
+        )
+        # per-tenant cost rollups (the capacity-planning scrape)
+        for field, help_text in (
+            ("requests", "Requests completed per tenant"),
+            ("tokens_relayed", "Tokens relayed per tenant"),
+            ("pages_held_ticks", "KV page x tick capacity consumed per tenant"),
+            ("decode_ticks", "Decode ticks consumed per tenant"),
+            ("migrations", "Stream migrations per tenant"),
+        ):
+            reg.counter_func(
+                f"router_tenant_{field}", help_text,
+                (lambda f=field: self.tenants.samples(f)),
+            )
         # the four per-replica families share ONE registry snapshot per
         # scrape: render() calls the callbacks in registration order, so the
         # first (router_replica_up) refreshes the cell and the other three
@@ -1189,6 +1630,7 @@ class RouterServer:
     def _post_replica(
         self, rep: Replica, path: str, body: dict,
         rid: Optional[str] = None, timeout: Optional[float] = None,
+        hop: Optional[int] = None,
     ) -> Tuple[int, dict]:
         """Small JSON POST helper (admin + probe paths, not the relay)."""
         conn = http.client.HTTPConnection(
@@ -1198,6 +1640,8 @@ class RouterServer:
             headers = {"Content-Type": "application/json"}
             if rid:
                 headers["X-Request-Id"] = rid
+            if hop is not None:
+                headers["X-Trace-Hop"] = str(hop)
             conn.request("POST", path, json.dumps(body), headers)
             resp = conn.getresponse()
             payload = resp.read()
@@ -1245,10 +1689,17 @@ class RouterServer:
                 "request_id": rid,
             }, headers={"X-Request-Id": rid})
             return
+        # tenant key for the cost-ledger rollup (header wins over body
+        # field; absent traffic pools under "anon")
+        tenant = str(
+            handler.headers.get("X-Tenant-Key") or req.get("tenant") or "anon"
+        )
         if req.get("stream", True):
             self._bump("streams")
             state = {"ids": [], "texts": [], "terminal": False,
-                     "headers_sent": False, "failover_count": 0}
+                     "headers_sent": False, "failover_count": 0,
+                     "hops": 0, "replica_ids": [], "ledger": None,
+                     "replayed": 0, "tenant": tenant}
             try:
                 self._relay_stream(handler, req, rid, state)
             finally:
@@ -1260,17 +1711,21 @@ class RouterServer:
                     self._bump("dropped_streams")
         else:
             self._bump("json_requests")
-            self._relay_json(handler, req, rid)
+            self._relay_json(handler, req, rid, tenant=tenant)
 
     # ---- JSON (non-stream) relay: nothing reaches the client until the
     # replica's full response is in hand, so every failure mode is a safe
     # wholesale retry on another replica.
 
-    def _relay_json(self, handler, req: dict, rid: str) -> None:
+    def _relay_json(self, handler, req: dict, rid: str,
+                    tenant: str = "anon") -> None:
         t0 = self.clock()
         tried: Set[str] = set()
         retry_after = 1.0
         last_error = "no routable replica"
+        hops = 0
+        failovers = 0
+        attach_hops = 0
         for attempt in range(self.max_attempts):
             rep = self._route(req.get("tokens"), tried)
             if rep is None:
@@ -1278,16 +1733,19 @@ class RouterServer:
             tried.add(rep.id)
             self.registry.inc_relay(rep.id)
             hop0 = self.clock()
+            hop_idx = hops
+            hops += 1
             status, doc, dead = None, None, None
             try:
-                code_doc = self._post_replica(rep, "/generate", req, rid=rid)
+                code_doc = self._post_replica(rep, "/generate", req, rid=rid,
+                                              hop=hop_idx)
                 status, doc = code_doc
             except (OSError, http.client.HTTPException) as exc:
                 dead = f"{type(exc).__name__}: {exc}"
             finally:
                 self.registry.dec_relay(rep.id)
                 self.tracer.add("relay", rid, hop0, self.clock(), {
-                    "replica": rep.id, "mode": "json",
+                    "replica": rep.id, "mode": "json", "hop": hop_idx,
                     "status": status if status is not None else "dead",
                 })
             if dead is not None:
@@ -1295,6 +1753,7 @@ class RouterServer:
                     self.registry.observe_relay_failure(rep.id, dead)
                 )
                 self._bump("failovers")
+                failovers += 1
                 last_error = f"replica {rep.id} failed: {dead}"
                 time.sleep(self.retry_backoff_s * (2 ** attempt))
                 continue
@@ -1312,6 +1771,7 @@ class RouterServer:
                     )
                 )
                 self._bump("failovers")
+                failovers += 1
                 last_error = str(doc.get("error", f"replica {status}"))
                 time.sleep(self.retry_backoff_s * (2 ** attempt))
                 continue
@@ -1319,34 +1779,54 @@ class RouterServer:
                 # the replica admitted, then its engine failed the request
                 # retryably (tick fault); nothing reached the client — retry
                 self._bump("failovers")
+                failovers += 1
                 last_error = str(doc.get("error", "replica engine failure"))
                 continue
+            replicas_crossed = {rep.id}
             if status == 200 and doc.get("status") == "migrated" and doc.get(
                 "migrated_to"
             ):
                 # the stream moved mid-request (drain-as-migrate or a
                 # disaggregated handoff): collect the continuation at its
                 # new home — zero tokens replayed
-                ids2, done2 = self._attach_collect(doc["migrated_to"], rid)
+                ids2, done2 = self._attach_collect(
+                    doc["migrated_to"], rid, hop=hops
+                )
                 if done2 is None or done2.get("status") != "done":
                     self._bump("failovers")
+                    failovers += 1
                     last_error = (
                         f"migrated stream lost at {doc['migrated_to']}"
                     )
                     continue
                 self._bump("migration_resumes")
+                attach_hops += 1
+                replicas_crossed.add(_parse_url(doc["migrated_to"])[0])
                 doc = {
                     "status": "done",
                     "tokens": (doc.get("tokens") or []) + ids2,
                     "text": (doc.get("text") or "") + str(
                         done2.get("text", "")
                     ),
+                    # the attach hop's done event carries the CUMULATIVE
+                    # engine ledger (it rode the page-span payload)
+                    "ledger": done2.get("ledger", doc.get("ledger")),
                 }
             n_tokens = len(doc.get("tokens") or ())
             self.registry.add_tokens(rep.id, n_tokens)
             self._bump("tokens_relayed", n_tokens)
             doc["request_id"] = rid
             doc["replica"] = rep.id
+            doc["ledger"] = complete_ledger(
+                doc.get("ledger"),
+                replicas_crossed=len(replicas_crossed),
+                failovers=failovers,
+                attach_hops=attach_hops,
+                resume_replayed_tokens=0,
+                tokens_relayed=n_tokens,
+                relay_ms=round((self.clock() - t0) * 1e3, 3),
+            )
+            self.tenants.record(tenant, doc["ledger"])
             self._finish_trace(rid, t0, doc.get("status", str(status)),
                                failovers=len(tried) - 1)
             handler._json(status, doc, headers={"X-Request-Id": rid})
@@ -1426,8 +1906,12 @@ class RouterServer:
                     # path exists to avoid (and the counter the
                     # zero-replay proof pins)
                     self._bump("resume_replayed_tokens", relayed)
+                    state["replayed"] = state.get("replayed", 0) + relayed
             self.registry.inc_relay(rep.id)
             hop0 = self.clock()
+            hop_idx = state.get("hops", 0)
+            state["hops"] = hop_idx + 1
+            state.setdefault("replica_ids", []).append(rep.id)
             hop_tokens_before = relayed
             conn = None
             outcome, detail = "dead", "connect"
@@ -1439,7 +1923,8 @@ class RouterServer:
                     conn.request(
                         "POST", hop_path, json.dumps(body),
                         {"Content-Type": "application/json",
-                         "X-Request-Id": rid},
+                         "X-Request-Id": rid,
+                         "X-Trace-Hop": str(hop_idx)},
                     )
                     resp = conn.getresponse()
                 except (OSError, http.client.HTTPException) as exc:
@@ -1535,6 +2020,11 @@ class RouterServer:
                     return
                 if kind == "done":
                     status = str(payload.get("status", "done"))
+                    if payload.get("ledger") is not None:
+                        # the engine's cumulative cost ledger for this
+                        # stream (migration hops carry it forward, so the
+                        # LAST done event always holds the full total)
+                        state["ledger"] = payload["ledger"]
                     if status == "migrated" and payload.get("migrated_to"):
                         # the replica shipped this stream's pages (live
                         # migration / drain-as-migrate): follow them with
@@ -1607,7 +2097,7 @@ class RouterServer:
                 hop_n = len(state["ids"]) - hop_tokens_before
                 self.registry.add_tokens(rep.id, hop_n)
                 self.tracer.add("relay", rid, hop0, self.clock(), {
-                    "replica": rep.id, "tokens": hop_n,
+                    "replica": rep.id, "tokens": hop_n, "hop": hop_idx,
                     "resumed": hop_tokens_before > 0,
                     "outcome": outcome, "detail": detail,
                 })
@@ -1708,6 +2198,18 @@ class RouterServer:
             "text": "".join(state["texts"]),
             "request_id": rid,
             "failovers": state.get("failover_count", 0),
+            # the complete per-request cost ledger: the engine's cumulative
+            # counters (from the final hop's done event) + the fleet-side
+            # fields only the router knows — also rolled up per tenant
+            "ledger": complete_ledger(
+                state.get("ledger"),
+                replicas_crossed=len(set(state.get("replica_ids", []))),
+                failovers=state.get("failover_count", 0),
+                attach_hops=state.get("attach_hops", 0),
+                resume_replayed_tokens=state.get("replayed", 0),
+                tokens_relayed=len(state["ids"]),
+                relay_ms=round((self.clock() - t0) * 1e3, 3),
+            ),
         }
         if error:
             event["error"] = error
@@ -1719,6 +2221,7 @@ class RouterServer:
             # the survivor finished what a dead replica started: one resumed
             # stream, however many hops the failover chain crossed
             self._bump("resumed_streams")
+        self.tenants.record(state.get("tenant", "anon"), event["ledger"])
         state["terminal"] = True
         self._finish_trace(rid, t0, status, event["failovers"])
         try:
@@ -1919,6 +2422,9 @@ class RouterServer:
         n = sig["routable"]
         if n == 0:
             return  # nothing routable is an outage, not a scaling problem
+        slo_hot = self.consume_slo_hot()
+        if slo_hot:
+            sig["slo_fast_burn"] = True
         hot = (
             sig["queued"] / n >= self.scale_up_queue
             or (
@@ -1929,6 +2435,9 @@ class RouterServer:
                 self.scale_up_free_pages > 0
                 and sig["min_free_pages"] < self.scale_up_free_pages
             )
+            # the SLO engine's fast-burn up-signal: the declared objective
+            # is dying faster than its budget — capacity now, diagnose later
+            or slo_hot
         )
         idle = (
             sig["queued"] == 0 and sig["active"] <= self.scale_down_active

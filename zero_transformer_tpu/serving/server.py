@@ -164,6 +164,17 @@ class ServingServer:
                 path, _, query = self.path.partition("?")
                 if path == "/healthz":
                     self._json(*outer._healthz())
+                elif path == "/admin/spans":
+                    # the fleet-trace stitch seam (PR 15): the router pulls
+                    # this replica's span tail for one request id and maps
+                    # it onto its own clock — admin-gated like every other
+                    # /admin route (span attrs can carry prompt-adjacent
+                    # metadata)
+                    if not outer._admin_allowed(self):
+                        self._json(403, {"error": "admin endpoint: loopback "
+                                                  "or bearer token required"})
+                        return
+                    self._json(*outer._admin_spans(query))
                 elif path == "/metrics":
                     accept = self.headers.get("Accept") or ""
                     if (
@@ -316,6 +327,11 @@ class ServingServer:
         return (200 if ok else 503), {
             "status": "ok" if ok else state,
             "state": state,
+            # this replica's monotonic clock AT ANSWER TIME: the router
+            # brackets the probe with its own clock and estimates the
+            # per-process offset (NTP-style midpoint) that lets it map
+            # this replica's span timestamps onto one fleet timeline
+            "clock_monotonic": self.engine.now(),
             "uptime_s": round(self.engine.lifecycle.uptime_s, 3),
             "reloads": self.engine.stats["reloads"],
             "breaker_open": self.engine._breaker.open,
@@ -355,6 +371,30 @@ class ServingServer:
             auth = handler.headers.get("Authorization", "")
             return auth == f"Bearer {self.admin_token}"
         return False
+
+    def _admin_spans(self, query: str):
+        """(code, body) for GET /admin/spans?request_id=<rid>[&tail=N]:
+        this replica's span tail for one request track (or the whole ring
+        tail with no request_id), plus the engine clock reading the router
+        needs to place these spans on the fleet timeline."""
+        from urllib.parse import parse_qs
+
+        params = parse_qs(query)
+        rid = (params.get("request_id") or [None])[0]
+        try:
+            tail = int((params.get("tail") or [2000])[0])
+        except (TypeError, ValueError):
+            return 400, {"error": "tail must be an integer"}
+        spans = self.engine.tracer.track_dicts(
+            track=rid if rid else None, tail=max(1, min(tail, 20000)),
+        )
+        return 200, {
+            "request_id": rid or "",
+            "clock_monotonic": self.engine.now(),
+            "role": self.engine.role,
+            "spans": spans,
+            "spans_dropped": self.engine.tracer.dropped,
+        }
 
     def _reload(self, req: dict):
         """(code, body) for POST /admin/reload: load a standby tree in THIS
@@ -613,7 +653,8 @@ class ServingServer:
 
     # -------------------------------------------------------------- request
 
-    def _submit(self, req: dict, request_id: Optional[str] = None):
+    def _submit(self, req: dict, request_id: Optional[str] = None,
+                trace_hop: Optional[int] = None):
         if "tokens" in req:
             ids = [int(t) for t in req["tokens"]]
         else:
@@ -627,7 +668,21 @@ class ServingServer:
             prefill_to=(
                 str(req["prefill_to"]) if req.get("prefill_to") else None
             ),
+            trace_hop=trace_hop,
         )
+
+    @staticmethod
+    def _trace_hop_of(handler) -> Optional[int]:
+        """The router's propagated hop index (X-Trace-Hop), or None for a
+        direct client — a garbled header is a dropped trace attr, never a
+        rejected request."""
+        raw = handler.headers.get("X-Trace-Hop")
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            return None
 
     def _generate(self, handler, req: dict) -> None:
         # inbound correlation id (header wins over body field); the engine
@@ -635,7 +690,8 @@ class ServingServer:
         # every response carries it back as X-Request-Id
         rid_in = handler.headers.get("X-Request-Id") or req.get("request_id")
         try:
-            handle = self._submit(req, request_id=rid_in)
+            handle = self._submit(req, request_id=rid_in,
+                                  trace_hop=self._trace_hop_of(handler))
         except (TypeError, ValueError) as exc:
             # ill-typed field VALUES ({"timeout": "abc"}) are the client's
             # error — 400, not a dropped connection with a server traceback
@@ -685,6 +741,10 @@ class ServingServer:
             doc = {
                 "status": handle.status, "tokens": tokens, "text": text,
                 "request_id": handle.rid,
+                # per-request cost ledger (PR 15): what this generation
+                # actually consumed — the router completes it with
+                # fleet-side fields and rolls it up per tenant
+                "ledger": handle.ledger_snapshot(),
             }
             if handle.status == MIGRATED:
                 # disaggregated handoff: the stream continues at this
@@ -753,6 +813,9 @@ class ServingServer:
                 # failure mid-stream is resumed on another replica
                 "retryable": handle.retryable,
                 "request_id": handle.rid,
+                # per-request cost ledger (PR 15), cumulative across
+                # migration hops (it rides the page-span payload)
+                "ledger": handle.ledger_snapshot(),
             }
             if handle.status == MIGRATED:
                 # zero-recompute handoff: the router attaches at the named
