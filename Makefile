@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos disagg-chaos obs bench bench-watch serve-bench train-bench kernel-bench tune tune-smoke e2e-watch fmt fmt-check dryrun lint
+.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos disagg-chaos chaos-fleet obs bench bench-watch serve-bench train-bench kernel-bench tune tune-smoke e2e-watch fmt fmt-check dryrun lint
 
 # Invariant lint lane (ISSUE 10): graftlint's repo-specific AST rules +
 # the suppression audit over the whole tree. Pure stdlib — no jax import,
@@ -61,6 +61,18 @@ serve-chaos:
 # the quick lane.
 router-chaos:
 	$(PY) -m pytest tests/test_router.py -q -m chaos $(PYTEST_ARGS)
+
+# Training-fleet fault-injection lane (ISSUE 17): N real worker processes
+# training under a supervising coordinator — one SIGKILLed mid-run (bounded
+# replay <= snapshot interval, loss trajectory rejoins the unfaulted run
+# bitwise), a heartbeat blackhole (declared dead, then rejoins), a SIGSTOP
+# hang (survivors finish bitwise without it), a slow worker (detected as a
+# straggler and shed), and a full-fleet kill (snapshot rewind, bounded
+# replay). The fast deterministic fleet cases (shard assignment, fold
+# algebra, registry edge cases, HTTP surface) are un-marked and run in the
+# quick lane.
+chaos-fleet:
+	$(PY) -m pytest tests/test_fleet_train.py -q -m chaos $(PYTEST_ARGS)
 
 # Disaggregated-fleet fault-injection lane (ISSUE 12): SIGKILL a
 # prefill-role replica mid-long-prompt-flood (every stream finishes

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Training-fleet coordinator: control plane + worker supervisor.
+
+Starts the :class:`FleetCoordinator` HTTP control plane, spawns N worker
+processes (scripts/train_fleet_worker.py), and supervises them: a worker
+that exits is respawned with ``--resume`` after a jittered exponential
+backoff (the same ``backoff_delay`` the in-process Supervisor uses —
+simultaneous respawns after a correlated fault would otherwise stampede
+the join endpoint). Worker death DETECTION is not this loop's job: the
+coordinator's heartbeat sweeper cordons silent workers and re-layouts the
+shard assignment among survivors; this loop only brings capacity back.
+
+The coordinator process itself performs no jax computation, so it stays
+responsive while workers grind through compiles.
+
+Artifacts (all optional flags):
+  --bench-out    BENCH_fleet_train.json (re-layout downtime, replayed steps)
+  --trace-out    fleet-stitched Perfetto trace for --trace-step
+  --status-out   full coordinator status (loss history, relayouts, events)
+  --losses-out   loss history alone — feed a later run's --control-losses
+  --control-losses  reference loss history; sets bitwise_rejoin in bench
+
+Examples:
+  python scripts/train_coordinator.py --workers 3 --steps 12
+  python scripts/train_coordinator.py --workers 3 --steps 20 \
+      --chaos w1=sigkill@7 --respawn 2 --bench-out BENCH_fleet_train.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from zero_transformer_tpu.obs.fleet import write_trace  # noqa: E402
+from zero_transformer_tpu.resilience.supervisor import backoff_delay  # noqa: E402
+from zero_transformer_tpu.training.fleet import (  # noqa: E402
+    CoordinatorServer,
+    FleetCoordinator,
+)
+
+WORKER_SCRIPT = os.path.join(_REPO, "scripts", "train_fleet_worker.py")
+
+
+def parse_chaos(specs):
+    """``wid=kind@step[:dur]`` -> {wid: [spec, ...]} (validated lazily by
+    the worker's own parser, which owns the Fault grammar)."""
+    out = {}
+    for s in specs:
+        wid, sep, spec = s.partition("=")
+        if not sep:
+            raise SystemExit(f"bad --chaos {s!r} (want wid=kind@step[:dur])")
+        out.setdefault(wid, []).append(spec)
+    return out
+
+
+class WorkerProc:
+    """One supervised worker slot: the process handle plus respawn state."""
+
+    def __init__(self, wid, chaos_specs, log_path=None):
+        self.wid = wid
+        self.chaos_specs = chaos_specs
+        self.log_path = log_path
+        self.proc = None
+        self.attempts = 0  # spawns so far
+        self.next_spawn_t = 0.0  # monotonic gate for backoff
+        self.exits = []
+
+    def spawn(self, url, args, resume):
+        cmd = [
+            sys.executable, WORKER_SCRIPT,
+            "--coordinator", url,
+            "--id", self.wid,
+            "--hb-interval", str(args.hb_interval),
+        ]
+        if args.ckpt_dir:
+            cmd += ["--ckpt-dir", args.ckpt_dir]
+        if resume:
+            cmd += ["--resume"]
+        # chaos only on the first life: a respawned worker must not re-kill
+        # itself at the same step counter and livelock the run
+        if self.attempts == 0:
+            for spec in self.chaos_specs:
+                cmd += ["--chaos", spec]
+        if self.log_path:
+            out = open(self.log_path, "ab")
+        else:
+            out = subprocess.DEVNULL
+        self.proc = subprocess.Popen(
+            cmd, stdout=out, stderr=subprocess.STDOUT, cwd=_REPO
+        )
+        if self.log_path:
+            out.close()  # child holds its own fd
+        self.attempts += 1
+        return self.proc
+
+
+def kill_all(slots):
+    # SIGKILL, not SIGTERM: a SIGSTOPped worker never delivers SIGTERM
+    for s in slots:
+        if s.proc is not None and s.proc.poll() is None:
+            try:
+                s.proc.send_signal(signal.SIGKILL)
+            except OSError:
+                pass  # already reaped
+    for s in slots:
+        if s.proc is not None:
+            try:
+                s.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                print(f"coordinator: worker {s.wid} unkillable?", file=sys.stderr)
+
+
+def losses_bitwise_equal(ours, reference):
+    if len(ours) != len(reference):
+        return False
+    return all(
+        s == rs and l == rl for (s, l), (rs, rl) in zip(ours, reference)
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--per-shard-batch", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--snapshot-every", type=int, default=5)
+    ap.add_argument("--min-workers", type=int, default=1)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--hb-timeout", type=float, default=0.75)
+    ap.add_argument("--hb-interval", type=float, default=0.2)
+    ap.add_argument("--eject-threshold", type=int, default=3)
+    ap.add_argument(
+        "--chaos", action="append", default=[], metavar="WID=KIND@STEP[:DUR]",
+        help="inject a fault into one worker (repeatable)",
+    )
+    ap.add_argument(
+        "--respawn", type=int, default=0, metavar="N",
+        help="respawn a dead worker up to N times (with jittered backoff)",
+    )
+    ap.add_argument("--backoff-base", type=float, default=0.05)
+    ap.add_argument("--backoff-max", type=float, default=1.0)
+    ap.add_argument("--backoff-jitter", type=float, default=0.1)
+    ap.add_argument(
+        "--no-spawn", action="store_true",
+        help="serve only; workers are started externally (prints COORD_URL=)",
+    )
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--worker-logs", default=None, metavar="DIR")
+    ap.add_argument("--bench-out", default=None)
+    ap.add_argument("--trace-out", default=None)
+    ap.add_argument("--trace-step", type=int, default=None)
+    ap.add_argument("--status-out", default=None)
+    ap.add_argument("--losses-out", default=None)
+    ap.add_argument("--control-losses", default=None)
+    args = ap.parse_args(argv)
+
+    chaos_by_wid = parse_chaos(args.chaos)
+    coord = FleetCoordinator(
+        n_shards=args.shards,
+        per_shard_batch=args.per_shard_batch,
+        seq_len=args.seq_len,
+        vocab=args.vocab,
+        seed=args.seed,
+        total_steps=args.steps,
+        snapshot_every=args.snapshot_every,
+        min_workers=args.min_workers,
+        lr=args.lr,
+        ckpt_dir=args.ckpt_dir,
+        hb_timeout_s=args.hb_timeout,
+        eject_threshold=args.eject_threshold,
+    )
+    server = CoordinatorServer(coord, port=args.port).start()
+    print(f"COORD_URL={server.url}", flush=True)
+
+    if args.worker_logs:
+        os.makedirs(args.worker_logs, exist_ok=True)
+
+    slots = []
+    if not args.no_spawn:
+        for i in range(args.workers):
+            wid = f"w{i}"
+            log = (
+                os.path.join(args.worker_logs, f"{wid}.log")
+                if args.worker_logs else None
+            )
+            slot = WorkerProc(wid, chaos_by_wid.get(wid, ()), log_path=log)
+            slot.spawn(server.url, args, resume=False)
+            slots.append(slot)
+
+    deadline = time.monotonic() + args.timeout
+    timed_out = False
+    try:
+        while not coord.done.wait(0.1):
+            now = time.monotonic()
+            if now > deadline:
+                timed_out = True
+                print("coordinator: wall-clock timeout", file=sys.stderr)
+                coord.stop()
+                break
+            for s in slots:
+                if s.proc is not None and s.proc.poll() is not None:
+                    rc = s.proc.returncode
+                    s.exits.append(rc)
+                    s.proc = None
+                    respawns_used = s.attempts - 1
+                    if respawns_used < args.respawn and not coord.stopping:
+                        delay = backoff_delay(
+                            args.backoff_base, args.backoff_max,
+                            respawns_used + 1, jitter=args.backoff_jitter,
+                        )
+                        s.next_spawn_t = now + delay
+                        print(
+                            f"coordinator: {s.wid} exited rc={rc}; "
+                            f"respawn in {delay:.3f}s",
+                            flush=True,
+                        )
+                    else:
+                        s.next_spawn_t = float("inf")
+                elif s.proc is None and now >= s.next_spawn_t:
+                    s.spawn(server.url, args, resume=bool(args.ckpt_dir))
+                    print(
+                        f"coordinator: respawned {s.wid} "
+                        f"(attempt {s.attempts})",
+                        flush=True,
+                    )
+    finally:
+        # give cleanly-finishing workers a moment to see "stop" and exit,
+        # then reap the rest (hung/stopped ones included) with SIGKILL
+        settle = time.monotonic() + 3.0
+        while time.monotonic() < settle and any(
+            s.proc is not None and s.proc.poll() is None for s in slots
+        ):
+            time.sleep(0.05)
+        kill_all(slots)
+        server.close()
+
+    status = coord.status()
+    losses = status["loss_history"]
+    bitwise = None
+    if args.control_losses:
+        with open(args.control_losses) as f:
+            bitwise = losses_bitwise_equal(losses, json.load(f))
+        print(f"BITWISE_REJOIN={bitwise}", flush=True)
+
+    if args.losses_out:
+        with open(args.losses_out, "w") as f:
+            json.dump(losses, f)
+    if args.status_out:
+        with open(args.status_out, "w") as f:
+            json.dump(status, f, indent=1)
+    if args.bench_out:
+        doc = coord.bench(chaos=args.chaos, bitwise_rejoin=bitwise)
+        with open(args.bench_out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(
+            f"BENCH relayouts={len(doc['relayouts'])} "
+            f"replayed_steps={doc['replayed_steps']} "
+            f"downtime_s={doc['relayout_downtime_s']:.3f}",
+            flush=True,
+        )
+    if args.trace_out:
+        step = args.trace_step
+        if step is None:
+            step = status["committed"]
+        write_trace(args.trace_out, coord.trace_doc(step))
+        print(f"TRACE step={step} -> {args.trace_out}", flush=True)
+
+    done = status["committed"] + 1
+    print(f"COORD_OK steps={done} relayouts={len(status['relayouts'])}", flush=True)
+    if timed_out:
+        return 2
+    return 0 if done >= args.steps else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,611 @@
+"""MPMD training fleet: fold determinism, elastic re-layout, chaos recovery.
+
+Two tiers:
+
+- Fast unit tests (tier-1): pure-numpy fold/shard/wire contracts, the
+  FleetRegistry facade over serving's registry, and FleetCoordinator
+  control-plane logic driven directly (no subprocesses, no jax compute —
+  stub "workers" post hand-built numpy gradient docs).
+
+- ``slow + chaos`` multi-process scenarios: real coordinator + N real
+  worker processes (scripts/train_coordinator.py), faults injected with
+  ChaosMonkey process-level kinds. The acceptance bar: SIGKILLing a
+  worker costs bounded replay and the recovered run's loss trajectory is
+  BITWISE identical to an unfaulted control run.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zero_transformer_tpu.obs.fleet import detect_stragglers, verify_stitched
+from zero_transformer_tpu.training.fleet import (
+    FLEET_BENCH_REQUIRED_KEYS,
+    CoordinatorServer,
+    FleetCoordinator,
+    FleetRegistry,
+    assign_shards,
+    decode_leaves,
+    encode_leaves,
+    fold_losses,
+    fold_shard_leaves,
+    http_json,
+    scale_leaves,
+    shard_batch,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COORD_SCRIPT = os.path.join(REPO, "scripts", "train_coordinator.py")
+
+
+# ---------------------------------------------------------------- fold contracts
+
+
+def test_assign_shards_covers_all_shards_deterministically():
+    a = assign_shards(["w2", "w0", "w1"], 7)
+    assert sorted(s for ss in a.values() for s in ss) == list(range(7))
+    # pure function of the (sorted) live set — order of discovery is noise
+    assert a == assign_shards(["w0", "w1", "w2"], 7)
+    # more workers than shards: the surplus worker is shardless, not failed
+    b = assign_shards(["w0", "w1", "w2"], 2)
+    assert b["w2"] == ()
+
+
+def test_shard_batch_counter_addressed():
+    a = shard_batch(seed=3, step=5, shard=1, per_shard=4, seq_len=8, vocab=50)
+    b = shard_batch(seed=3, step=5, shard=1, per_shard=4, seq_len=8, vocab=50)
+    assert a.dtype == np.int32 and a.shape == (4, 8)
+    np.testing.assert_array_equal(a, b)  # replay regenerates identical data
+    assert not np.array_equal(
+        a, shard_batch(seed=3, step=5, shard=2, per_shard=4, seq_len=8, vocab=50)
+    )
+    assert not np.array_equal(
+        a, shard_batch(seed=3, step=6, shard=1, per_shard=4, seq_len=8, vocab=50)
+    )
+
+
+def test_encode_decode_leaves_bitwise_roundtrip():
+    rng = np.random.default_rng(0)
+    leaves = [
+        rng.standard_normal((3, 5)).astype(np.float32),
+        rng.integers(0, 9, size=(7,), dtype=np.int32),
+        np.float32(1e-30) * rng.standard_normal((2, 2, 2)).astype(np.float32),
+    ]
+    out = decode_leaves(encode_leaves(leaves))
+    assert len(out) == len(leaves)
+    for a, b in zip(leaves, out):
+        assert a.dtype == b.dtype
+        assert a.tobytes() == b.tobytes()  # bit-exact, not allclose
+
+
+def test_fold_is_invariant_to_contribution_arrival_order():
+    rng = np.random.default_rng(1)
+    per_shard = {
+        s: [rng.standard_normal((4, 3)).astype(np.float32)] for s in range(4)
+    }
+    folded1 = fold_shard_leaves({s: per_shard[s] for s in [0, 1, 2, 3]})
+    folded2 = fold_shard_leaves({s: per_shard[s] for s in [3, 1, 0, 2]})
+    assert folded1[0].tobytes() == folded2[0].tobytes()
+    # fixed left-fold bracketing, spelled out
+    expect = ((per_shard[0][0] + per_shard[1][0]) + per_shard[2][0]) + per_shard[3][0]
+    assert folded1[0].tobytes() == expect.tobytes()
+    scaled = scale_leaves(folded1, 4)
+    assert scaled[0].dtype == np.float32
+    assert scaled[0].tobytes() == (expect * np.float32(0.25)).tobytes()
+
+
+def test_fold_losses_fixed_order():
+    losses = {2: 0.3, 0: 0.1, 1: 0.2}
+    a = fold_losses(losses, 3)
+    b = fold_losses(dict(sorted(losses.items())), 3)
+    assert a == b
+
+
+# ---------------------------------------------------------------- registry facade
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_fleet_registry_silence_ejects_after_threshold():
+    clk = FakeClock()
+    reg = FleetRegistry(clock=clk, hb_timeout_s=1.0, eject_threshold=3)
+    reg.register("w0")
+    reg.register("w1")
+    assert reg.live() == ["w0", "w1"]
+    for _ in range(3):
+        clk.t += 1.5  # w1 goes silent; w0 keeps beating
+        assert reg.heartbeat("w0", {})
+        events = reg.sweep()
+        if ("ejected", "w1") in events:
+            break
+    else:
+        pytest.fail("w1 never ejected despite heartbeat silence")
+    assert not reg.is_live("w1")
+    assert reg.is_live("w0")
+
+
+def test_fleet_registry_late_heartbeat_from_removed_worker_dropped():
+    clk = FakeClock()
+    reg = FleetRegistry(clock=clk, hb_timeout_s=1.0)
+    reg.register("w0")
+    reg.remove("w0")
+    # the straggling heartbeat must NOT resurrect the row
+    assert reg.heartbeat("w0", {}) is False
+    assert reg.live() == []
+    assert not reg.is_live("w0")
+
+
+def test_fleet_registry_reregister_gets_fresh_row_not_stale_cordon():
+    clk = FakeClock()
+    reg = FleetRegistry(clock=clk, hb_timeout_s=1.0)
+    reg.register("w0")
+    reg.cordon("w0")
+    assert not reg.is_live("w0")
+    # SIGKILLed worker respawns under the same id: fresh row, no cordon
+    reg.register("w0")
+    assert reg.is_live("w0")
+
+
+# ------------------------------------------------------------ coordinator logic
+
+
+def _grad_doc(value, shape=(2, 2)):
+    return encode_leaves([np.full(shape, value, dtype=np.float32)])
+
+
+def _submit_all(coord, wid, step, values, timeout=2.0):
+    """One worker posting every shard of ``step`` in a single call.
+
+    NB: ``timeout`` is measured on the COORDINATOR's clock — tests driving
+    a frozen FakeClock must pass 0 or the barrier wait never expires."""
+    docs = {str(s): _grad_doc(v) for s, v in values.items()}
+    losses = {str(s): float(s) * 0.1 for s in values}
+    return coord.submit(wid, coord.epoch, step, docs, losses, timeout=timeout)
+
+
+def test_fold_barrier_releases_mean_of_shards():
+    coord = FleetCoordinator(n_shards=3, total_steps=None)
+    coord.join("w0")
+    out = _submit_all(coord, "w0", 0, {0: 1.0, 1: 2.0, 2: 6.0})
+    assert out.get("ok"), out
+    grads = decode_leaves(out["grads"])
+    np.testing.assert_array_equal(
+        grads[0], np.full((2, 2), 3.0, dtype=np.float32)
+    )
+    assert coord.committed == 0
+
+
+def test_final_fold_is_delivered_before_stop():
+    coord = FleetCoordinator(n_shards=2, total_steps=1)
+    coord.join("w0")
+    out = _submit_all(coord, "w0", 0, {0: 1.0, 1: 3.0})
+    # the run-ending fold must still reach the submitter — a bare "stop"
+    # here would strand the final optimizer step on the coordinator
+    assert out.get("ok"), out
+    assert coord.stopping and coord.done.is_set()
+    assert _submit_all(coord, "w0", 1, {0: 1.0, 1: 1.0}).get("stop")
+
+
+def test_join_after_stop_is_refused_with_stop():
+    coord = FleetCoordinator(n_shards=1, total_steps=1)
+    coord.join("w0")
+    _submit_all(coord, "w0", 0, {0: 1.0})
+    epochs_before = coord.epoch
+    out = coord.join("w9")
+    assert out.get("stop")
+    assert out["assignment"] == {}
+    assert coord.epoch == epochs_before  # no phantom relayout record
+
+
+def test_relayout_keeps_partial_contribs_and_replays_only_missing_shards():
+    clk = FakeClock()
+    coord = FleetCoordinator(
+        n_shards=3, min_workers=1, hb_timeout_s=1.0, eject_threshold=3,
+        clock=clk,
+    )
+    coord.join("w0")
+    coord.join("w1")
+    assert coord.assignment == {"w0": (0, 2), "w1": (1,)}
+    # w0 delivers its shards; w1's shard 1 never arrives
+    out = _submit_all(coord, "w0", 0, {0: 1.0, 2: 5.0}, timeout=0)
+    assert out.get("retry"), out
+    assert sorted(coord.contribs) == [0, 2]
+    # w1 goes silent -> ejected -> loss relayout
+    for _ in range(4):
+        clk.t += 1.5
+        coord.registry.heartbeat("w0", {})
+        coord.sweep()
+        if not coord.registry.is_live("w1"):
+            break
+    assert coord.assignment == {"w0": (0, 1, 2)}
+    rec = coord.relayouts[-1]
+    assert rec.lost == ("w1",)
+    assert rec.replayed_shards == 1  # NOT 3: partial contribs survived
+    assert sorted(coord.contribs) == [0, 2]
+    # survivor supplies only the missing shard under the new epoch
+    out = coord.submit(
+        "w0", coord.epoch, 0, {"1": _grad_doc(3.0)}, {"1": 0.1}, timeout=2.0
+    )
+    assert out.get("ok"), out
+    np.testing.assert_array_equal(
+        decode_leaves(out["grads"])[0], np.full((2, 2), 3.0, dtype=np.float32)
+    )
+
+
+def test_stale_epoch_submit_bounced_with_new_layout():
+    coord = FleetCoordinator(n_shards=2)
+    coord.join("w0")
+    old_epoch = coord.epoch
+    coord.join("w1")  # bumps the epoch
+    out = coord.submit(
+        "w0", old_epoch, 0, {"0": _grad_doc(1.0)}, {"0": 0.0}, timeout=2.0
+    )
+    assert out.get("relayout"), out
+    assert out["epoch"] == coord.epoch
+    assert "w1" in out["assignment"]
+
+
+def test_submit_from_removed_worker_is_gone():
+    coord = FleetCoordinator(n_shards=1)
+    coord.join("w0")
+    coord.registry.remove("w0")
+    out = coord.submit("w0", coord.epoch, 0, {}, {}, timeout=0.1)
+    assert out.get("gone")
+
+
+def test_late_heartbeat_into_coordinator_dropped_with_event():
+    coord = FleetCoordinator(n_shards=1)
+    assert coord.heartbeat("ghost", {"step": 0}) is None  # HTTP layer: 410
+    assert any(
+        e["event"] == "late_heartbeat_dropped" and e["wid"] == "ghost"
+        for e in coord.events
+    )
+
+
+def test_sole_survivor_snapshot_rewind_is_bounded():
+    clk = FakeClock()
+    coord = FleetCoordinator(
+        n_shards=1, snapshot_every=3, hb_timeout_s=1.0, clock=clk
+    )
+    coord.join("w0")
+    for s in range(5):
+        assert _submit_all(coord, "w0", s, {0: float(s)}).get("ok")
+    assert coord.committed == 4
+    losses_before = list(coord.loss_history)
+    for _ in range(4):  # whole fleet dies
+        clk.t += 1.5
+        coord.sweep()
+    assert coord.registry.live() == []
+    # respawned worker restored the step-3 snapshot; fold line rewinds to it
+    out = coord.join("w0", version=3)
+    assert coord.committed == 2
+    rec = coord.relayouts[-1]
+    assert rec.reason == "rewind:w0"
+    assert rec.replayed_steps == 2
+    assert rec.replayed_steps <= coord.snapshot_every  # the bounded-replay bar
+    assert [e[0] for e in coord.loss_history] == [0, 1, 2]
+    # replay re-produces the exact losses that were rewound away
+    for s in (3, 4):
+        out = _submit_all(coord, "w0", s, {0: float(s)})
+        assert out.get("ok")
+    assert coord.loss_history == losses_before
+
+
+def _compute_spans(step0, n, dur, t0=1000.0):
+    spans = []
+    t = t0
+    for i in range(n):
+        spans.append(
+            {"track": f"step-{step0 + i}", "name": "compute",
+             "t0": t, "t1": t + dur, "attrs": {}}
+        )
+        t += dur + 0.001
+    return spans
+
+
+def test_detect_stragglers_median_robust():
+    groups = [
+        {"process": "w0", "offset_s": 0.0, "spans": _compute_spans(0, 5, 0.01)},
+        {"process": "w1", "offset_s": 0.0, "spans": _compute_spans(0, 5, 0.012)},
+        {"process": "w2", "offset_s": 0.0, "spans": _compute_spans(0, 5, 0.11)},
+    ]
+    rep = detect_stragglers(groups, factor=3.0, min_spans=4)
+    assert rep["w2"]["straggler"] and rep["w2"]["ratio"] > 3.0
+    assert not rep["w0"]["straggler"] and not rep["w1"]["straggler"]
+    # a lone process has no fleet to lag behind
+    assert not detect_stragglers(groups[:1], factor=3.0, min_spans=4)["w0"]["straggler"]
+    # too few samples: no verdict
+    few = [dict(g, spans=g["spans"][:2]) for g in groups]
+    assert not detect_stragglers(few, factor=3.0, min_spans=4)["w2"]["straggler"]
+
+
+def test_straggler_shed_moves_shard_to_fastest_worker():
+    # three processes: with only two, the median baseline sits halfway
+    # between fast and slow and fleet-relative detection (correctly) abstains
+    coord = FleetCoordinator(
+        n_shards=6, straggler_factor=3.0, straggler_min_spans=4
+    )
+    for w in ("w0", "w1", "w2"):
+        coord.join(w)
+    assert coord.assignment == {"w0": (0, 3), "w1": (1, 4), "w2": (2, 5)}
+    coord.worker_spans["w0"] = _compute_spans(0, 6, 0.01)
+    coord.worker_spans["w1"] = _compute_spans(0, 6, 0.2)
+    coord.worker_spans["w2"] = _compute_spans(0, 6, 0.012)
+    coord.sweep()
+    assert any(e["event"] == "straggler_detected" for e in coord.events)
+    assert coord.relayouts[-1].reason == "shed:w1->w0"
+    assert len(coord.assignment["w1"]) == 1
+    all_shards = sorted(s for ss in coord.assignment.values() for s in ss)
+    assert all_shards == [0, 1, 2, 3, 4, 5]  # shed re-homes work, never drops it
+
+
+def test_min_workers_start_gate_holds_first_fold():
+    coord = FleetCoordinator(n_shards=2, min_workers=2)
+    coord.join("w0")
+    assert coord.assignment == {}  # gate closed: nobody owns shards yet
+    coord.join("w1")
+    assert set(coord.assignment) == {"w0", "w1"}
+
+
+def test_bench_document_schema_and_json_safety():
+    coord = FleetCoordinator(n_shards=1, total_steps=2)
+    coord.join("w0")
+    for s in range(2):
+        _submit_all(coord, "w0", s, {0: 1.0})
+    doc = coord.bench(chaos=["w0=sigkill@1"], bitwise_rejoin=True)
+    assert set(FLEET_BENCH_REQUIRED_KEYS) <= set(doc)
+    json.dumps(doc, allow_nan=False)  # NaN downtime must never leak out
+    assert doc["steps"] == 2
+    assert doc["bitwise_rejoin"] is True
+
+
+def test_trace_doc_stitches_worker_and_coordinator_spans():
+    coord = FleetCoordinator(n_shards=2)
+    coord.join("w0")
+    t0 = coord.clock()
+    out = _submit_all(coord, "w0", 0, {0: 1.0, 1: 2.0})
+    assert out.get("ok")
+    # worker-side spans arrive via heartbeat drain
+    coord.heartbeat(
+        "w0",
+        {"step": 1, "offset_s": 0.0, "spans": [
+            {"track": "step-0", "name": "compute", "t0": t0, "t1": t0 + 0.001,
+             "attrs": {"shard": 0}},
+            {"track": "step-0", "name": "post", "t0": t0 + 0.001,
+             "t1": coord.clock(), "attrs": {}},
+        ]},
+    )
+    doc = coord.trace_doc(0)
+    names = {
+        e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"
+    }
+    assert {"route", "compute", "post"} <= names
+    rep = verify_stitched(doc, "step-0")
+    assert rep["orphans"] == 0
+    assert rep["spans"] >= 3
+
+
+def test_http_control_plane_roundtrip():
+    coord = FleetCoordinator(n_shards=1, total_steps=2)
+    with CoordinatorServer(coord, sweep_interval_s=0.05) as srv:
+        _, join = http_json(srv.url, "/join", {"wid": "w0", "offset_s": 0.0})
+        assert join["bootstrap"] == "init"
+        assert join["cfg"]["n_shards"] == 1
+        status, _ = http_json(
+            srv.url, "/heartbeat", {"wid": "ghost", "step": 0}
+        )
+        assert status == 410  # unknown worker must re-join, not be re-added
+        for s in range(2):
+            _, out = http_json(
+                srv.url, "/grads",
+                {"wid": "w0", "epoch": join["epoch"], "step": s,
+                 "shards": {"0": _grad_doc(float(s + 1))},
+                 "losses": {"0": 0.5}},
+            )
+            assert out.get("ok"), out
+        _, st = http_json(srv.url, "/status")
+        assert st["committed"] == 1 and st["stopping"]
+        _, clk = http_json(srv.url, "/clock")
+        assert "clock_monotonic" in clk
+
+
+# ------------------------------------------------- committed chaos-proof artifact
+
+
+def test_committed_fleet_bench_artifact_proves_bounded_replay():
+    path = os.path.join(REPO, "BENCH_fleet_train.json")
+    assert os.path.exists(path), "chaos-proof artifact missing"
+    doc = json.load(open(path))
+    assert set(FLEET_BENCH_REQUIRED_KEYS) <= set(doc)
+    assert doc["bitwise_rejoin"] is True
+    assert doc["workers"] >= 3
+    assert any("sigkill" in c for c in doc["chaos"])
+    # the acceptance bound: replay after a kill <= snapshot interval
+    assert 1 <= doc["replayed_steps"] <= doc["snapshot_every"]
+    assert doc["relayout_downtime_s"] >= 0.0
+    assert any(r["lost"] for r in doc["relayouts"])
+
+
+def test_committed_fleet_trace_is_stitched():
+    path = os.path.join(REPO, "BENCH_fleet_train.trace.json")
+    assert os.path.exists(path), "fleet trace artifact missing"
+    doc = json.load(open(path))
+    roots = [
+        e for e in doc["traceEvents"]
+        if e.get("ph") == "X" and e["name"] == "route"
+    ]
+    assert roots, "no global-step root span"
+    track = roots[0]["cat"]
+    rep = verify_stitched(doc, track)
+    assert rep["orphans"] == 0
+    assert rep["spans"] >= 4
+    # more than one process contributed to the step's merged timeline
+    pids = {
+        e["pid"] for e in doc["traceEvents"]
+        if e.get("ph") == "X" and e.get("cat") == track
+    }
+    assert len(pids) >= 2
+
+
+# ------------------------------------------------------- multi-process scenarios
+
+
+def _run_fleet(tmp, *, steps=10, workers=3, chaos=(), respawn=0,
+               snapshot_every=3, control=None, extra=()):
+    out = {
+        "losses": os.path.join(tmp, "losses.json"),
+        "status": os.path.join(tmp, "status.json"),
+        "bench": os.path.join(tmp, "bench.json"),
+        "logs": os.path.join(tmp, "logs"),
+    }
+    cmd = [
+        sys.executable, COORD_SCRIPT,
+        "--workers", str(workers), "--steps", str(steps),
+        "--shards", "4", "--snapshot-every", str(snapshot_every),
+        "--ckpt-dir", os.path.join(tmp, "ckpt"),
+        "--worker-logs", out["logs"],
+        "--losses-out", out["losses"],
+        "--status-out", out["status"],
+        "--bench-out", out["bench"],
+        "--respawn", str(respawn),
+        "--timeout", "150",
+    ]
+    if respawn:
+        # first respawn must land AFTER the death sweep (hb_timeout 0.75s):
+        # the scenario under test is detect -> re-layout -> re-admit, not a
+        # replacement sneaking in before the fleet notices the loss
+        cmd += ["--backoff-base", "1.5"]
+    for c in chaos:
+        cmd += ["--chaos", c]
+    if control:
+        cmd += ["--control-losses", control]
+    cmd += list(extra)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=240
+    )
+    return proc, out
+
+
+def _worker_logs(paths):
+    text = ""
+    for name in sorted(os.listdir(paths["logs"])):
+        text += open(os.path.join(paths["logs"], name)).read()
+    return text
+
+
+@pytest.fixture(scope="module")
+def control_losses(tmp_path_factory):
+    """One unfaulted 10-step run; every chaos scenario's bitwise reference."""
+    tmp = str(tmp_path_factory.mktemp("fleet_control"))
+    proc, paths = _run_fleet(tmp)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    losses = json.load(open(paths["losses"]))
+    assert len(losses) == 10
+    return paths["losses"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_bounded_replay_bitwise_rejoin(tmp_path, control_losses):
+    """THE acceptance scenario: SIGKILL one of three workers mid-run; the
+    fleet re-layouts, replays at most the partial step, and the recovered
+    loss trajectory rejoins the unfaulted control bitwise."""
+    proc, paths = _run_fleet(
+        str(tmp_path), chaos=["w1=sigkill@4"], respawn=2,
+        control=control_losses,
+        extra=["--trace-out", os.path.join(str(tmp_path), "trace.json"),
+               "--trace-step", "7"],
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "BITWISE_REJOIN=True" in proc.stdout
+    bench = json.load(open(paths["bench"]))
+    assert bench["bitwise_rejoin"] is True
+    assert 1 <= bench["replayed_steps"] <= bench["snapshot_every"]
+    status = json.load(open(paths["status"]))
+    assert any(r["lost"] == ["w1"] for r in status["relayouts"])
+    doc = json.load(open(os.path.join(str(tmp_path), "trace.json")))
+    rep = verify_stitched(doc, "step-7")
+    assert rep["orphans"] == 0 and rep["spans"] >= 4
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_heartbeat_blackhole_declared_dead_then_rejoins(tmp_path, control_losses):
+    # a warm-cache global step takes ~50ms, so an unpaced 10-step run ends
+    # before heartbeat silence can cross the death threshold. The uniform
+    # slow_worker sleep paces every worker equally: pure wall-clock, zero
+    # effect on the math — the bitwise check against the unpaced control
+    # run is itself evidence of that.
+    pace = [f"w{i}=slow_worker@0:0.08" for i in range(3)]
+    proc, paths = _run_fleet(
+        str(tmp_path), chaos=pace + ["w2=hb_blackhole@3:2.5"],
+        control=control_losses, extra=["--hb-timeout", "0.5"],
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # partitioned, ejected, and re-admitted through a FRESH registry row —
+    # the trajectory never notices
+    assert "declared dead by coordinator" in _worker_logs(paths)
+    assert "BITWISE_REJOIN=True" in proc.stdout
+    status = json.load(open(paths["status"]))
+    assert any("w2" in r["lost"] for r in status["relayouts"])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigstop_hang_survivors_finish_bitwise(tmp_path, control_losses):
+    proc, paths = _run_fleet(
+        str(tmp_path), chaos=["w1=sigstop@3"], control=control_losses
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "BITWISE_REJOIN=True" in proc.stdout
+    status = json.load(open(paths["status"]))
+    assert any("w1" in r["lost"] for r in status["relayouts"])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_slow_worker_detected_as_straggler(tmp_path):
+    proc, paths = _run_fleet(
+        str(tmp_path), steps=12, chaos=["w1=slow_worker@2:0.4"],
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    status = json.load(open(paths["status"]))
+    assert "w1" in status["stragglers"], status["stragglers"]
+    assert any(
+        e["event"] == "straggler_detected" and e["wid"] == "w1"
+        for e in status["events"]
+    )
+    # shedding moved load but never changed the math
+    losses = json.load(open(paths["losses"]))
+    assert len(losses) == 12
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_full_fleet_kill_snapshot_rewind_bounded(tmp_path, control_losses):
+    """Sole worker SIGKILLed between snapshots: the respawn restores the
+    latest verified snapshot, the coordinator rewinds the fold line to it,
+    and replay is bounded by the snapshot interval. Worker count differs
+    from the 3-worker control — the trajectory must not care."""
+    proc, paths = _run_fleet(
+        str(tmp_path), workers=1, chaos=["w0=sigkill@5"], respawn=2,
+        control=control_losses,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "BITWISE_REJOIN=True" in proc.stdout
+    status = json.load(open(paths["status"]))
+    rewinds = [r for r in status["relayouts"] if r["reason"].startswith("rewind:")]
+    assert rewinds, status["relayouts"]
+    assert 1 <= rewinds[0]["replayed_steps"] <= 3

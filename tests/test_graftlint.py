@@ -115,6 +115,111 @@ def test_donation_safety_reassignment_clears_taint():
     assert SR.analyze_source(src) == []
 
 
+# ------------------------------------- control-plane except rule (path-scoped)
+# No flat fixture pair for this rule: it fires only when the module PATH is
+# in a control-plane location, so the fixtures are inline sources analyzed
+# under explicit in-scope / out-of-scope paths.
+
+IN_SCOPE = "zero_transformer_tpu/training/fleet.py"
+
+
+def test_control_plane_bare_except_flagged():
+    src = (
+        "def sweep(self):\n"
+        "    try:\n"
+        "        self._relayout()\n"
+        "    except:\n"
+        "        pass\n"
+    )
+    findings = SR.analyze_source(src, path=IN_SCOPE)
+    assert [f.rule for f in findings] == ["swallowed-except-in-control-plane"]
+    assert "bare 'except:'" in findings[0].message
+
+
+@pytest.mark.parametrize("exc", ["Exception", "BaseException"])
+@pytest.mark.parametrize("body", ["pass", "continue", "..."])
+def test_control_plane_swallow_only_broad_except_flagged(exc, body):
+    src = (
+        "def hb_loop(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            self.post()\n"
+        f"        except {exc}:\n"
+        f"            {body}\n"
+    )
+    findings = SR.analyze_source(src, path=IN_SCOPE)
+    assert [f.rule for f in findings] == ["swallowed-except-in-control-plane"]
+    assert "swallows the failure" in findings[0].message
+
+
+def test_control_plane_observing_broad_except_clean():
+    """Control loops legitimately outlive individual failures — a broad
+    except that LOGS (or otherwise acts) is the sanctioned shape."""
+    src = (
+        "def hb_loop(self):\n"
+        "    try:\n"
+        "        self.post()\n"
+        "    except Exception:\n"
+        "        log.exception('heartbeat post failed; retrying')\n"
+    )
+    assert SR.analyze_source(src, path=IN_SCOPE) == []
+
+
+def test_control_plane_narrow_except_pass_clean():
+    """Swallowing a NAMED exception is a deliberate, reviewable choice —
+    only the catch-everything shapes are flagged."""
+    src = (
+        "def poll(self):\n"
+        "    try:\n"
+        "        self.q.get_nowait()\n"
+        "    except KeyError:\n"
+        "        pass\n"
+    )
+    assert SR.analyze_source(src, path=IN_SCOPE) == []
+
+
+@pytest.mark.parametrize(
+    "path",
+    [
+        "zero_transformer_tpu/resilience/supervisor.py",
+        "zero_transformer_tpu/training/fleet.py",
+        "zero_transformer_tpu/serving/router.py",
+        "scripts/train_coordinator.py",
+        "scripts/train_fleet_worker.py",
+        "scripts/serve_router.py",
+    ],
+)
+def test_control_plane_scope_covers_all_declared_paths(path):
+    src = "try:\n    go()\nexcept:\n    pass\n"
+    findings = SR.analyze_source(src, path=path)
+    assert [f.rule for f in findings] == ["swallowed-except-in-control-plane"]
+
+
+def test_control_plane_rule_ignores_out_of_scope_paths():
+    """Data-plane / model code is governed by the opt-in supervised-seam
+    rule, not this one — the same source outside the scope list is clean."""
+    src = "try:\n    go()\nexcept Exception:\n    pass\n"
+    for path in (
+        "zero_transformer_tpu/model/attention.py",
+        "zero_transformer_tpu/training/loop.py",
+        "tests/test_fleet_train.py",
+    ):
+        assert SR.analyze_source(src, path=path) == [], path
+
+
+def test_control_plane_suppressible_with_reason():
+    src = (
+        "def drain(self):\n"
+        "    try:\n"
+        "        self.sock.close()\n"
+        "    # graftlint: allow[swallowed-except-in-control-plane] reason=best-effort close on teardown\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    (f,) = SR.analyze_source(src, path=IN_SCOPE)
+    assert f.suppressed and f.reason == "best-effort close on teardown"
+
+
 # ------------------------------------------------------- suppression audit
 
 

@@ -33,6 +33,7 @@ from zero_transformer_tpu.resilience import (
     RetryableError,
     Supervisor,
     Watchdog,
+    backoff_delay,
     classify,
 )
 from zero_transformer_tpu.resilience.watchdog import dump_stacks
@@ -111,6 +112,99 @@ def test_classify_taxonomy():
     assert classify(KeyboardInterrupt()) == "fatal"
     # unknown bugs default fatal: a blind restart loop is not recovery
     assert classify(RuntimeError("some novel crash")) == "fatal"
+
+
+def test_classify_walks_cause_chain_explicit_raise_from():
+    """A RetryableError re-raised under a fatal-looking wrapper type must
+    classify by the innermost cause: the loader raising ``ValueError(...)
+    from RetryableError`` is still a transient IO failure underneath."""
+    try:
+        try:
+            raise RetryableError("shard read reset")
+        except RetryableError as inner:
+            raise ValueError("while decoding shard 7") from inner
+    except ValueError as exc:
+        wrapped = exc
+    assert classify(wrapped) == "retryable"
+
+
+def test_classify_walks_cause_chain_implicit_context():
+    """Same honor for the implicit ``__context__`` chain — an exception
+    raised INSIDE an ``except RetryableError:`` block carries the original
+    as context, not cause."""
+    try:
+        try:
+            raise RetryableError("watchdog abort")
+        except RetryableError:
+            raise KeyError("cleanup lookup failed")
+    except KeyError as exc:
+        wrapped = exc
+    assert wrapped.__cause__ is None and wrapped.__context__ is not None
+    assert classify(wrapped) == "retryable"
+
+
+def test_classify_retryable_wrapping_fatal_stays_retryable():
+    # reversed nesting order: the outermost exception IS a RetryableError,
+    # whatever it wrapped
+    try:
+        try:
+            raise ValueError("bad shape deep down")
+        except ValueError as inner:
+            raise RetryableError("transient wrapper") from inner
+    except RetryableError as exc:
+        wrapped = exc
+    assert classify(wrapped) == "retryable"
+
+
+def test_classify_user_interrupt_beats_cause_chain():
+    """Ctrl-C wins even when a RetryableError sits underneath: the user
+    asked the run to die, the supervisor must not resurrect it."""
+    ki = KeyboardInterrupt()
+    ki.__cause__ = RetryableError("mid-retry when interrupted")
+    assert classify(ki) == "fatal"
+
+
+def test_classify_cause_cycle_terminates():
+    a = RuntimeError("a")
+    b = RuntimeError("b")
+    a.__cause__, b.__cause__ = b, a
+    assert classify(a) == "fatal"  # and, crucially, it returns at all
+
+
+# -- backoff jitter (satellite of the fleet supervisor) ----------------------
+
+
+def test_backoff_delay_pinned_to_jitter_window():
+    """The jittered delay is PINNED inside [base*2^(k-1)*(1-j), ...*(1+j)]:
+    rng extremes map exactly onto the window edges, the midpoint is the
+    undithered exponential value, and the cap applies before jitter."""
+    for attempt, nominal in [(1, 1.0), (2, 2.0), (3, 4.0), (10, 60.0)]:
+        lo = backoff_delay(1.0, 60.0, attempt, jitter=0.25, rng=lambda: 0.0)
+        mid = backoff_delay(1.0, 60.0, attempt, jitter=0.25, rng=lambda: 0.5)
+        hi = backoff_delay(1.0, 60.0, attempt, jitter=0.25, rng=lambda: 1.0)
+        assert mid == pytest.approx(nominal)
+        assert lo == pytest.approx(nominal * 0.75)
+        assert hi == pytest.approx(nominal * 1.25)
+    # jitter=0 degenerates to the old deterministic schedule
+    assert backoff_delay(0.01, 1.0, 2, jitter=0.0) == pytest.approx(0.02)
+    # sampled delays stay inside the window and actually spread
+    import random as _random
+
+    rng = _random.Random(7).random
+    samples = [
+        backoff_delay(1.0, 60.0, 1, jitter=0.1, rng=rng) for _ in range(64)
+    ]
+    assert all(0.9 <= s <= 1.1 for s in samples)
+    assert len({round(s, 6) for s in samples}) > 10  # not secretly constant
+
+
+def test_config_backoff_jitter_validation():
+    with pytest.raises(ValueError, match="backoff_jitter"):
+        ResilienceConfig(backoff_jitter=1.0)
+    with pytest.raises(ValueError, match="backoff_jitter"):
+        ResilienceConfig(backoff_jitter=-0.1)
+    ResilienceConfig(backoff_jitter=0.0)  # edges that must remain legal
+    ResilienceConfig(backoff_jitter=0.999)
 
 
 def test_config_resilience_block_validation():
@@ -269,7 +363,10 @@ def test_loader_error_supervised_recovers(tmp_path, devices):
                                    save_frequency=4)
     assert int(state.step) == 12
     assert len(sup.history) == 1 and "OSError" in sup.history[0].reason
-    assert sleeps == [sup.res.backoff_base_s]
+    # one backoff sleep, inside the jitter window around the base delay
+    assert len(sleeps) == 1
+    b, j = sup.res.backoff_base_s, sup.res.backoff_jitter
+    assert b * (1 - j) <= sleeps[0] <= b * (1 + j)
     assert "loader_error@6" in chaos.fired_log
 
 
@@ -374,8 +471,41 @@ def test_supervisor_budget_exhaustion(tmp_path, devices):
     sup = Supervisor(cfg, trainer_factory=Always, sleep_fn=sleeps.append)
     with pytest.raises(RetryableError, match="restart budget exhausted"):
         sup.run()
-    # exponential backoff: base, 2*base
-    assert sleeps == pytest.approx([0.01, 0.02])
+    # exponential backoff (base, 2*base), each dithered by the jitter window
+    j = sup.res.backoff_jitter
+    assert len(sleeps) == 2
+    assert 0.01 * (1 - j) <= sleeps[0] <= 0.01 * (1 + j)
+    assert 0.02 * (1 - j) <= sleeps[1] <= 0.02 * (1 + j)
+
+
+def test_supervisor_backoff_deterministic_with_seeded_rng(tmp_path, devices):
+    """An injected rng makes the jittered schedule reproducible — the seam
+    the fleet tests (and anyone replaying an incident) rely on."""
+    cfg = tiny_config(tmp_path, total_steps=4)
+    cfg = dataclasses.replace(
+        cfg,
+        resilience=ResilienceConfig(
+            max_restarts=2, backoff_base_s=0.01, backoff_jitter=0.5
+        ),
+    )
+
+    class Always:
+        def __init__(self, c):
+            pass
+
+        def train(self, max_steps=None):
+            raise OSError("bucket gone")
+
+        def close(self):
+            pass
+
+    sleeps = []
+    sup = Supervisor(
+        cfg, trainer_factory=Always, sleep_fn=sleeps.append, rng=lambda: 1.0
+    )
+    with pytest.raises(RetryableError):
+        sup.run()
+    assert sleeps == pytest.approx([0.015, 0.03])  # top edge of each window
 
 
 # -- trustworthy restore: integrity + replica-audit chaos --------------------

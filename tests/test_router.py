@@ -151,6 +151,54 @@ def test_registry_honors_replica_lifecycle_states():
     assert _ids(reg.routable()) == [r1]
 
 
+def test_registry_reregister_replace_does_not_resurrect_stale_cordon():
+    """A SIGKILLed process that re-registers under the same id must get a
+    FRESH row: inheriting the dead predecessor's cordon (or its tripped
+    breaker) would keep the new, healthy process out of rotation forever.
+    The training fleet's re-admission path rides exactly this seam."""
+    clk = FakeClock()
+    reg = ReplicaRegistry(["http://h:1"], clock=clk, eject_threshold=3)
+    r1 = "h:1"
+    reg.observe_probe(r1, True, 200, {"state": READY})
+    # the old incarnation dies: failures trip the breaker, ops cordons it
+    for _ in range(3):
+        reg.observe_probe(r1, False)
+    reg.cordon(r1)
+    assert reg.get(r1).state == EJECTED and reg.routable() == []
+
+    # default add() is the idempotent admin path: same id short-circuits,
+    # stale state intentionally preserved (re-adding a draining live
+    # replica must not silently uncordon it)
+    assert reg.add("http://h:1") == r1
+    assert reg.get(r1).cordoned and reg.get(r1).state == EJECTED
+
+    # replace=True is the reincarnation path: clean slate
+    assert reg.add("http://h:1", replace=True) == r1
+    rep = reg.get(r1)
+    assert not rep.cordoned
+    assert rep.state == UNKNOWN  # fresh rows still earn routability
+    assert rep.consecutive_failures == 0
+    assert reg.routable() == []  # not routable on trust alone
+    reg.observe_probe(r1, True, 200, {"state": READY})
+    assert _ids(reg.routable()) == [r1]
+
+
+def test_registry_probe_for_removed_replica_dropped_not_readded():
+    """Late health data from a removed member (probe completing mid-retire,
+    a worker heartbeat arriving after eviction) is DROPPED: re-admission is
+    an explicit add(), never a side effect of stale telemetry."""
+    clk = FakeClock()
+    reg = ReplicaRegistry(["http://h:1"], clock=clk)
+    r1 = "h:1"
+    reg.observe_probe(r1, True, 200, {"state": READY})
+    reg.remove(r1)
+    assert reg.observe_probe(r1, True, 200, {"state": READY}) == []
+    assert r1 not in reg.replicas and reg.routable() == []
+    # failure-shaped stragglers equally inert
+    assert reg.observe_probe(r1, False) == []
+    assert r1 not in reg.replicas
+
+
 def test_registry_relay_failure_feeds_breaker_and_reprobes_now():
     clk = FakeClock()
     reg = ReplicaRegistry(

@@ -28,6 +28,14 @@ Rules (catalog + rationale in docs/ANALYSIS.md):
 - ``lock-held-device-sync``: blocking device ops (the host-sync set) inside
   any ``with ...lock...:`` body — a device sync under the engine lock
   stalls every submit/scrape for the sync's duration.
+- ``swallowed-except-in-control-plane``: in resilience / fleet
+  control-plane files (``resilience/``, ``training/fleet``,
+  ``serving/router``, the coordinator/worker/router scripts), any bare
+  ``except:``, and any ``except Exception/BaseException:`` whose body is
+  only ``pass``/``...``/``continue``. The control plane's whole job is
+  turning failures into decisions; a swallowed exception there converts a
+  worker death or probe failure into silence — the one failure mode the
+  fleet cannot recover from, because it never learns anything happened.
 - ``sharding-spec``: ``PartitionSpec``/``P`` literals naming axes that are
   not declared mesh axes, or repeating an axis within one spec (the static
   half of ``analysis.spec_check``).
@@ -65,6 +73,19 @@ ALL_RULES = (
     "broad-except-in-supervised-seam",
     "lock-held-device-sync",
     "sharding-spec",
+    "swallowed-except-in-control-plane",
+)
+
+# path fragments that put a file in scope for the control-plane except rule:
+# the resilience layer and the fleet control planes (training coordinator +
+# serving router), where a swallowed exception silently disables recovery
+CONTROL_PLANE_PATH_PARTS = (
+    "resilience/",
+    "training/fleet",
+    "serving/router",
+    "scripts/train_coordinator",
+    "scripts/train_fleet_worker",
+    "scripts/serve_router",
 )
 # meta-rules guard the audit trail itself and are NOT suppressible
 META_RULES = ("suppression-missing-reason", "unused-suppression", "parse-error")
@@ -548,6 +569,63 @@ def _rule_broad_except(mod: _Module) -> List[Finding]:
     return out
 
 
+def _rule_control_plane_except(mod: _Module) -> List[Finding]:
+    """Bare ``except:`` / swallow-only broad excepts in control-plane files.
+
+    Unlike ``broad-except-in-supervised-seam`` (opt-in via marker, requires
+    classification), this rule is PATH-scoped and catches the two shapes
+    that are never right in a control plane: catching everything with no
+    type at all, and catching ``Exception``/``BaseException`` only to
+    discard it. A broad except that logs, re-raises, or acts is fine here —
+    control loops legitimately outlive individual failures, but they must
+    OBSERVE them."""
+    norm = mod.path.replace("\\", "/")
+    if not any(part in norm for part in CONTROL_PLANE_PATH_PARTS):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(
+                Finding(
+                    "swallowed-except-in-control-plane",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    "bare 'except:' in a resilience/fleet control-plane "
+                    "path — it catches SystemExit/KeyboardInterrupt too, "
+                    "and hides which failures the handler was written for",
+                )
+            )
+            continue
+        if _last(_dotted(node.type)) not in ("Exception", "BaseException"):
+            continue
+        swallow = all(
+            isinstance(stmt, (ast.Pass, ast.Continue))
+            or (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis
+            )
+            for stmt in node.body
+        )
+        if swallow:
+            out.append(
+                Finding(
+                    "swallowed-except-in-control-plane",
+                    mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    "'except "
+                    f"{_last(_dotted(node.type))}: pass' in a control-plane "
+                    "path swallows the failure the control plane exists to "
+                    "react to — log it, classify it, or re-raise it",
+                )
+            )
+    return out
+
+
 def _local_mesh_axes(mod: _Module) -> Set[str]:
     """Axis names a module declares on its OWN ``Mesh(...)`` constructions
     (probe/test meshes, e.g. pod_check's 1-D ``("all",)`` mesh) — legal for
@@ -859,6 +937,8 @@ def analyze_source(
         findings += _rule_lock_sync(mod)
     if "broad-except-in-supervised-seam" in want:
         findings += _rule_broad_except(mod)
+    if "swallowed-except-in-control-plane" in want:
+        findings += _rule_control_plane_except(mod)
     if "sharding-spec" in want:
         findings += _rule_sharding_spec(mod, mesh_axes or MESH_AXES)
     if "donation-safety" in want:

@@ -440,6 +440,11 @@ class ResilienceConfig:
     max_restarts: int = 3
     backoff_base_s: float = 2.0
     backoff_max_s: float = 300.0
+    # multiplicative backoff jitter: each delay is spread uniformly over
+    # [1-j, 1+j] so N workers restarting after a SHARED-cause failure (a
+    # storage blip, a preemption wave) don't thundering-herd the checkpoint
+    # store at the same instant. 0 disables (deterministic delays).
+    backoff_jitter: float = 0.1
 
     def __post_init__(self):
         if self.anomaly_response not in ("skip_batch", "rollback", "halt"):
@@ -473,6 +478,11 @@ class ResilienceConfig:
         if self.backoff_base_s <= 0 or self.backoff_max_s < self.backoff_base_s:
             raise ValueError(
                 "backoff_base_s must be > 0 and backoff_max_s >= backoff_base_s"
+            )
+        if not 0.0 <= self.backoff_jitter < 1.0:
+            raise ValueError(
+                "backoff_jitter must be in [0, 1): at 1.0 the jitter window "
+                "touches a zero delay, which defeats the backoff entirely"
             )
 
 

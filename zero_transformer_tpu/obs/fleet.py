@@ -550,6 +550,58 @@ def verify_stitched(
     }
 
 
+def detect_stragglers(
+    groups: Sequence[Dict[str, Any]],
+    span_name: str = "compute",
+    factor: float = 3.0,
+    min_spans: int = 4,
+    window: int = 8,
+) -> Dict[str, Dict[str, Any]]:
+    """Fleet-relative straggler detection over per-process span groups —
+    the SAME shape ``stitch_spans`` takes, so the training coordinator
+    feeds the identical data structure to the trace stitcher and the
+    straggler detector (one observability plane, two consumers).
+
+    Per process: the mean duration of its most recent ``window`` spans
+    named ``span_name``. Fleet baseline: the MEDIAN of those means (robust
+    to the straggler itself — a mean-of-means baseline would be dragged
+    toward the slow worker and mask it). A process is a straggler when its
+    mean exceeds ``factor`` x the fleet median and it has produced at
+    least ``min_spans`` samples (cold starts and compile steps must not
+    trip it). Returns ``{process: {"mean_s", "n", "ratio", "straggler"}}``.
+    """
+    means: Dict[str, Tuple[float, int]] = {}
+    for g in groups:
+        durs = [
+            float(s["t1"]) - float(s["t0"])
+            for s in g.get("spans", [])
+            if s.get("name") == span_name
+        ][-window:]
+        if durs:
+            means[str(g.get("process"))] = (sum(durs) / len(durs), len(durs))
+    if not means:
+        return {}
+    ordered = sorted(m for m, _ in means.values())
+    mid = len(ordered) // 2
+    median = (
+        ordered[mid]
+        if len(ordered) % 2
+        else (ordered[mid - 1] + ordered[mid]) / 2.0
+    )
+    out: Dict[str, Dict[str, Any]] = {}
+    for proc, (mean, n) in means.items():
+        ratio = mean / median if median > 0 else 1.0
+        out[proc] = {
+            "mean_s": mean,
+            "n": n,
+            "ratio": ratio,
+            "straggler": bool(
+                n >= min_spans and len(means) >= 2 and ratio >= factor
+            ),
+        }
+    return out
+
+
 def request_ids_in(doc: Dict[str, Any]) -> List[str]:
     """Every request id with a ``route`` root in a merged doc (per-run
     verification sweeps these)."""

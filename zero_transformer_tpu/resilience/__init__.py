@@ -71,6 +71,7 @@ _LAZY = {
     "perturb_one_replica": "chaos",
     "Supervisor": "supervisor",
     "classify": "supervisor",
+    "backoff_delay": "supervisor",
     "Watchdog": "watchdog",
 }
 

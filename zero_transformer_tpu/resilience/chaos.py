@@ -48,7 +48,19 @@ class Fault:
 
     kind: "nan_step" | "loader_error" | "sigterm" | "ckpt_fail" |
           "ckpt_slow" | "ckpt_truncate" | "ckpt_bitflip" | "hang" |
-          "replica_perturb"
+          "replica_perturb" | "sigkill" | "sigstop" | "hb_blackhole" |
+          "slow_worker"
+
+    The last four are PROCESS-level faults for the training fleet
+    (training/fleet.py): "sigkill" is unmaskable death (no handler, no
+    force-save — the coordinator must notice via missed heartbeats);
+    "sigstop" freezes the process without killing it (the
+    indistinguishable-from-hung case: heartbeats stop but the PID lives);
+    "hb_blackhole" drops outgoing heartbeats for ``duration`` seconds while
+    the worker keeps computing (a partitioned-but-alive worker — the
+    coordinator declares it dead and it must re-register); "slow_worker"
+    delays every shard compute by ``duration`` seconds from ``step`` on
+    (persistent straggler, for the obs-plane detection path).
     step: step at which to fire. For "nan_step" this is matched against the
       in-graph ``state.step`` (0-based step being computed); for host faults
       it is the 1-based count of completed steps; for "loader_error" the
@@ -258,13 +270,23 @@ class ChaosMonkey:
         return state
 
     def on_step(self, step: int) -> None:
-        """Host-side faults, called by the trainer after each completed step."""
-        for f in self._of_kind("sigterm", "hang"):
+        """Host-side faults, called by the trainer (and the fleet worker)
+        after each completed step."""
+        for f in self._of_kind("sigterm", "hang", "sigkill", "sigstop"):
             if f.fired or step < f.step:
                 continue
             self.record(f)
             if f.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "sigkill":
+                # unmaskable: no force-save, no atexit — the process is
+                # simply gone, which is exactly what the fleet's
+                # missed-heartbeat path must absorb
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "sigstop":
+                # frozen, not dead: the PID persists but nothing runs until
+                # an external SIGCONT/SIGKILL (the test harness owns that)
+                os.kill(os.getpid(), signal.SIGSTOP)
             else:
                 # interruptible busy-hang: short sleeps keep bytecode
                 # boundaries frequent so the watchdog's interrupt_main can
@@ -276,6 +298,36 @@ class ChaosMonkey:
                     "chaos: hang cap %.0fs elapsed without watchdog abort",
                     float(f.duration),
                 )
+
+    # -- fleet-worker seams -------------------------------------------------
+
+    def compute_delay(self, step: int) -> float:
+        """Per-shard compute delay in seconds ("slow_worker"): persistent
+        from ``fault.step`` on — a straggler is a condition, not an event,
+        so firing once does NOT clear it."""
+        delay = 0.0
+        for f in self._of_kind("slow_worker"):
+            if step < f.step:
+                continue
+            if not f.fired:
+                self.record(f)
+            delay += float(f.duration)
+        return delay
+
+    def drop_heartbeat(self, step: int) -> bool:
+        """True while an "hb_blackhole" fault wants outgoing heartbeats
+        dropped: from the first step >= ``fault.step``, for ``duration``
+        seconds of wall time. The worker stays alive and computing — only
+        its health signal is partitioned away."""
+        for f in self._of_kind("hb_blackhole"):
+            if step < f.step:
+                continue
+            if not f.fired:
+                self.record(f)
+                f.until = time.monotonic() + float(f.duration)
+            if time.monotonic() < getattr(f, "until", 0.0):
+                return True
+        return False
 
 
 def perturb_one_replica(state):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random
 import time
 from typing import Callable, List, Optional
 
@@ -65,6 +66,19 @@ _RETRYABLE_PATTERNS = (
 )
 
 
+def _cause_chain(exc: BaseException, limit: int = 50):
+    """``exc`` followed by its ``__cause__``/``__context__`` chain,
+    innermost last. Cycle-safe and depth-bounded (exception chains built by
+    retry wrappers can self-reference)."""
+    seen = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen and limit > 0:
+        yield cur
+        seen.add(id(cur))
+        cur = cur.__cause__ if cur.__cause__ is not None else cur.__context__
+        limit -= 1
+
+
 def classify(exc: BaseException) -> str:
     """``"retryable"`` | ``"fatal"`` for a train-loop exception.
 
@@ -74,11 +88,19 @@ def classify(exc: BaseException) -> str:
     message-fingerprint matching; anything unrecognized defaults to fatal —
     blindly restarting an unknown bug risks an infinite crash loop that
     *looks* like progress.
+
+    The ``RetryableError`` mark is honored through the whole
+    ``__cause__``/``__context__`` chain, not just the outermost type: a
+    retryable storage fault re-raised through (or merely re-wrapped inside)
+    a ``ValueError``-raising seam is still the SAME transient fault, and
+    classifying it by the accidental outer wrapper would burn a restartable
+    run. User interrupts stay fatal regardless of what they interrupted —
+    a Ctrl-C that lands mid-retry must not be "classified away".
     """
-    if isinstance(exc, RetryableError):
-        return "retryable"
     if isinstance(exc, (KeyboardInterrupt, SystemExit)):
         return "fatal"
+    if any(isinstance(e, RetryableError) for e in _cause_chain(exc)):
+        return "retryable"
     if isinstance(exc, _FATAL_TYPES):
         return "fatal"
     if isinstance(exc, (OSError, ConnectionError, TimeoutError)):
@@ -87,6 +109,28 @@ def classify(exc: BaseException) -> str:
     if any(pat in msg for pat in _RETRYABLE_PATTERNS):
         return "retryable"
     return "fatal"
+
+
+def backoff_delay(
+    base_s: float,
+    max_s: float,
+    attempt: int,
+    jitter: float = 0.0,
+    rng: Callable[[], float] = random.random,
+) -> float:
+    """Exponential backoff with multiplicative jitter.
+
+    ``min(base * 2^(attempt-1), max)`` spread uniformly over
+    ``[1 - jitter, 1 + jitter]``: after a shared-cause failure (storage
+    blip, preemption wave) N workers restart with DIFFERENT delays instead
+    of thundering-herd-ing the checkpoint store at the exact same instant —
+    the same reason the serving registry staggers its re-probes. Shared by
+    the in-process Supervisor and the fleet coordinator's worker respawn
+    path; the jitter window is pinned by tests/test_resilience.py."""
+    delay = min(base_s * (2.0 ** (attempt - 1)), max_s)
+    if jitter <= 0.0:
+        return delay
+    return delay * (1.0 + jitter * (2.0 * rng() - 1.0))
 
 
 @dataclasses.dataclass
@@ -109,6 +153,7 @@ class Supervisor:
         alive across restarts (a fault that fired stays fired).
       use_wandb: forwarded to the default factory.
       sleep_fn: injectable backoff sleep (tests pass a recorder).
+      rng: uniform [0,1) source for backoff jitter (tests pin it).
     """
 
     def __init__(
@@ -117,11 +162,13 @@ class Supervisor:
         trainer_factory: Optional[Callable[[Config], "object"]] = None,
         use_wandb: bool = False,
         sleep_fn: Callable[[float], None] = time.sleep,
+        rng: Callable[[], float] = random.random,
     ):
         self.cfg = cfg
         self.res = cfg.resilience
         self.use_wandb = use_wandb
         self.sleep_fn = sleep_fn
+        self.rng = rng
         self.history: List[RestartRecord] = []
         if trainer_factory is None:
 
@@ -133,8 +180,12 @@ class Supervisor:
         self.trainer_factory = trainer_factory
 
     def _backoff(self, attempt: int) -> float:
-        return min(
-            self.res.backoff_base_s * (2.0 ** (attempt - 1)), self.res.backoff_max_s
+        return backoff_delay(
+            self.res.backoff_base_s,
+            self.res.backoff_max_s,
+            attempt,
+            jitter=getattr(self.res, "backoff_jitter", 0.0),
+            rng=self.rng,
         )
 
     def _resumed_cfg(self, attempt: int) -> Config:

@@ -364,12 +364,22 @@ class ReplicaRegistry:
 
     # ------------------------------------------------------- fleet elasticity
 
-    def add(self, url: str) -> str:
+    def add(self, url: str, replace: bool = False) -> str:
         """Register a new replica (autoscale spawn): it enters UNKNOWN and
-        joins rotation on its first clean READY probe. Returns its id."""
+        joins rotation on its first clean READY probe. Returns its id.
+
+        ``replace=True`` re-registers an EXISTING id with a completely
+        fresh row (fresh breaker, no cordon, zeroed failure counts). A
+        process that died and came back under the same identity — a
+        SIGKILLed training worker rejoining the fleet, a replica restarted
+        in place — must not inherit its dead predecessor's cordon or
+        tripped breaker: that stale state would keep the NEW process out of
+        rotation forever (pinned by tests/test_router.py). The default
+        stays ``False`` for idempotent admin adds: re-adding a LIVE replica
+        mid-drain must not silently uncordon it."""
         rid, host, port = _parse_url(url)
         with self._lock:
-            if rid in self.replicas:
+            if rid in self.replicas and not replace:
                 return rid
             self.replicas[rid] = Replica(
                 id=rid, url=url, host=host, port=port,

@@ -1,0 +1,1522 @@
+"""MPMD training fleet: supervised multi-process training with heartbeats,
+elastic re-layout, and bounded-replay recovery.
+
+The serving half of this repo already runs as a fleet — replica registry,
+probe/eject state machine, cordon, migration, chaos proofs. This module
+lifts that control plane to TRAINING (ROADMAP item 4; MPMD pipelining,
+arXiv:2412.14374): the run is N supervised worker processes plus one
+coordinator, and worker death is an event the control plane absorbs, not a
+run-ending exception.
+
+Design, in one breath:
+
+- **Logical shards decouple layout from worker count.** The global batch is
+  a fixed set of ``n_shards`` per-step micro-batches, generated
+  counter-style from ``(seed, step, shard)`` — the stub for the data plane
+  a real DCN/loader feeds. Workers own disjoint shard subsets; an elastic
+  re-layout only REASSIGNS shards, never changes what any shard contains.
+- **The fold is the collective.** Workers push per-shard grads to the
+  coordinator, which left-folds them in ascending shard-id order (fp
+  addition is not associative — fixed bracketing is what makes the fold
+  bitwise-deterministic regardless of which worker computed which shard or
+  in what order contributions arrived). This is the stub transport seam: a
+  real deployment swaps the HTTP push/fold for DCN all-reduce with the same
+  reduction order contract (GSPMD determinism, arXiv:2105.04663).
+- **State is bitwise-replicated, so peers ARE the checkpoint.** Every
+  worker applies the identical folded update, so params/optimizer state
+  stay byte-identical across the fleet. A worker that dies between
+  snapshots restarts checkpoint-free from any live peer's state; disk
+  snapshots (orbax ``CheckpointManager`` — PR 5's verified-restore and
+  loader-remap machinery) are only needed when the WHOLE fleet dies, and
+  then replay is bounded by the snapshot interval.
+- **Heartbeats ride the serving registry.** ``FleetRegistry`` adapts the
+  push model (workers heartbeat) onto ``serving.router.ReplicaRegistry``'s
+  pull-shaped probe state machine: a received heartbeat is a successful
+  probe; a sweeper converts heartbeat silence into failed probes, so the
+  same breaker/eject/backoff logic that decides replica death decides
+  worker death. Straggler detection consumes the PR 15 obs plane's
+  stitched span groups (``obs.fleet.detect_stragglers``).
+
+Coordinator-side code performs NO jax computation — the fold is plain
+numpy on received bytes, so the control plane keeps running when a
+worker's backend is wedged and never compiles anything. ``FleetWorker``
+touches jax lazily, inside its own methods only.
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+import numpy as np
+
+from zero_transformer_tpu.obs.fleet import (
+    detect_stragglers,
+    estimate_clock_offset,
+    stitch_spans,
+    write_trace,
+)
+from zero_transformer_tpu.serving.router import READY, ReplicaRegistry
+
+log = logging.getLogger("zero_transformer_tpu")
+
+# folded results kept for laggards catching up after a blackhole/rejoin; a
+# worker further behind than this re-bootstraps full state from a peer
+FOLD_CACHE_STEPS = 8
+
+# BENCH_fleet_train.json schema (pinned by tests/test_fleet_train.py)
+FLEET_BENCH_REQUIRED_KEYS = (
+    "metric",
+    "workers",
+    "n_shards",
+    "steps",
+    "relayouts",
+    "replayed_steps",
+    "replayed_shards",
+    "relayout_downtime_s",
+    "snapshot_every",
+    "chaos",
+    "bitwise_rejoin",
+    "loss_first",
+    "loss_last",
+    "platform",
+)
+
+
+# ------------------------------------------------------------------- layout
+
+
+def assign_shards(workers: Sequence[str], n_shards: int) -> Dict[str, Tuple[int, ...]]:
+    """Deterministic round-robin shard assignment over SORTED worker ids.
+
+    Sorting makes the layout a pure function of the live set — every
+    relayout with the same survivors produces the same assignment, so a
+    flapping worker cannot make the layout (and with it the fold-barrier
+    membership) wander."""
+    ws = sorted(workers)
+    if not ws:
+        return {}
+    out: Dict[str, List[int]] = {w: [] for w in ws}
+    for s in range(n_shards):
+        out[ws[s % len(ws)]].append(s)
+    return {w: tuple(v) for w, v in out.items()}
+
+
+def shard_batch(
+    seed: int, step: int, shard: int, per_shard: int, seq_len: int, vocab: int
+) -> np.ndarray:
+    """Counter-based deterministic micro-batch for ``(step, shard)``.
+
+    Keyed on the logical shard, NOT the worker: after a re-layout the new
+    owner regenerates byte-identical data, which is what makes replay a
+    pure recompute instead of a data-loss event. (Stub for the real
+    loader's sharded tar streams, which are position-addressable the same
+    way — see ``remap_loader_state``.)"""
+    rng = np.random.default_rng([int(seed), int(step), int(shard)])
+    return rng.integers(0, vocab, size=(per_shard, seq_len), dtype=np.int32)
+
+
+# ------------------------------------------------------- leaf (de)serialization
+
+
+def encode_leaves(leaves: Sequence[np.ndarray]) -> Dict[str, Any]:
+    """JSON-safe encoding of a flat leaf list (b64 raw bytes + dtype/shape).
+
+    Raw ``tobytes`` round-trips bit-exactly — the wire format must not be
+    where the bitwise-rejoin claim dies."""
+    arrs = [np.ascontiguousarray(a) for a in leaves]
+    return {
+        "shapes": [list(a.shape) for a in arrs],
+        "dtypes": [str(a.dtype) for a in arrs],
+        "data": [base64.b64encode(a.tobytes()).decode("ascii") for a in arrs],
+    }
+
+
+def decode_leaves(doc: Dict[str, Any]) -> List[np.ndarray]:
+    out = []
+    for shape, dtype, data in zip(doc["shapes"], doc["dtypes"], doc["data"]):
+        raw = base64.b64decode(data)
+        out.append(
+            np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy()
+        )
+    return out
+
+
+def fold_shard_leaves(
+    contribs: Dict[int, List[np.ndarray]]
+) -> List[np.ndarray]:
+    """Left-fold per-shard leaf lists in ASCENDING shard-id order.
+
+    Floating-point addition is not associative: the fixed fold order (and
+    fixed bracketing — one running accumulator) is the entire determinism
+    contract. Any worker may have produced any contribution; the folded
+    bytes are identical regardless."""
+    shards = sorted(contribs)
+    acc = [np.array(l, copy=True) for l in contribs[shards[0]]]
+    for s in shards[1:]:
+        leaves = contribs[s]
+        if len(leaves) != len(acc):
+            raise ValueError(
+                f"shard {s} contributed {len(leaves)} leaves, expected {len(acc)}"
+            )
+        for i, l in enumerate(leaves):
+            acc[i] = acc[i] + l
+    return acc
+
+
+def scale_leaves(leaves: Sequence[np.ndarray], n: int) -> List[np.ndarray]:
+    """Mean-scale a folded sum by ``1/n`` — done ONCE, coordinator-side, so
+    every worker receives identical bytes (a per-worker divide would be a
+    second place for bit drift to enter)."""
+    s = np.float32(1.0 / n)
+    return [(l * s).astype(l.dtype) for l in leaves]
+
+
+def fold_losses(loss_by_shard: Dict[int, float], n_shards: int) -> float:
+    acc = np.float32(0.0)
+    for s in sorted(loss_by_shard):
+        acc = np.float32(acc + np.float32(loss_by_shard[s]))
+    return float(np.float32(acc * np.float32(1.0 / n_shards)))
+
+
+# ----------------------------------------------------------- fleet registry
+
+
+class FleetRegistry:
+    """Training-side facade over serving's ``ReplicaRegistry``.
+
+    Serving PULLS health (the router probes); training PUSHES it (workers
+    heartbeat). The adaptation: a received heartbeat is folded in as a
+    successful probe, and :meth:`sweep` converts heartbeat SILENCE into
+    synthetic failed probes — so the exact same breaker / eject-threshold /
+    cordon state machine that decides replica death decides worker death,
+    and its edge cases (stale-cordon resurrection, late data from a removed
+    member) are shared, tested once, and fixed once."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        hb_timeout_s: float = 0.75,
+        eject_threshold: int = 3,
+    ):
+        # ReplicaRegistry refuses to start empty (a router with no replicas
+        # is a config error); the fleet legitimately starts empty and fills
+        # on /join — bootstrap with a placeholder and drop it.
+        self._reg = ReplicaRegistry(
+            ["fleet-bootstrap"], clock=clock, eject_threshold=eject_threshold
+        )
+        self._reg.remove(next(iter(self._reg.replicas)))
+        self.clock = clock
+        self.hb_timeout_s = hb_timeout_s
+        self._rid: Dict[str, str] = {}  # wid -> registry rid
+        self._last_hb: Dict[str, float] = {}
+
+    def _wid_of(self, rid: str) -> Optional[str]:
+        for w, r in self._rid.items():
+            if r == rid:
+                return w
+        return None
+
+    def register(self, wid: str) -> str:
+        """Register (or RE-register) a worker.
+
+        ``replace=True`` is load-bearing: a worker that was SIGKILLed and
+        respawned under the same identity must get a fresh row — inheriting
+        the dead predecessor's cordon/breaker/ejection state would keep the
+        new process out of rotation forever (the stale-cordon resurrection
+        bug, pinned in tests/test_router.py)."""
+        rid = self._reg.add(wid, replace=True)
+        self._rid[wid] = rid
+        self._last_hb[wid] = self.clock()
+        self._reg.observe_probe(rid, ok=True, body={"state": READY})
+        return rid
+
+    def heartbeat(self, wid: str, body: Optional[dict] = None) -> bool:
+        """Fold one heartbeat in. Returns False for an unknown/removed
+        worker: a LATE heartbeat from a removed member is dropped, never
+        re-added — re-admission goes through :meth:`register` only."""
+        rid = self._rid.get(wid)
+        if rid is None or rid not in self._reg.replicas:
+            return False
+        self._last_hb[wid] = self.clock()
+        b = {"state": READY}
+        b.update(body or {})
+        self._reg.observe_probe(rid, ok=True, body=b)
+        return True
+
+    def sweep(self, now: Optional[float] = None) -> List[Tuple[str, str]]:
+        """Convert heartbeat silence into failed probes; returns lifecycle
+        events as ``(event, wid)`` — ``("ejected", wid)`` is worker loss."""
+        t = self.clock() if now is None else now
+        events: List[Tuple[str, str]] = []
+        for wid, rid in list(self._rid.items()):
+            if rid not in self._reg.replicas:
+                continue
+            if t - self._last_hb.get(wid, 0.0) > self.hb_timeout_s:
+                for ev, _ in self._reg.observe_probe(rid, ok=False):
+                    events.append((ev, wid))
+        return events
+
+    def live(self) -> List[str]:
+        return sorted(
+            wid
+            for wid, rid in self._rid.items()
+            if rid in self._reg.replicas and self._reg.replicas[rid].routable
+        )
+
+    def is_live(self, wid: str) -> bool:
+        rid = self._rid.get(wid)
+        if rid is None or rid not in self._reg.replicas:
+            return False
+        return self._reg.replicas[rid].routable
+
+    def cordon(self, wid: str) -> None:
+        rid = self._rid.get(wid)
+        if rid is not None:
+            self._reg.cordon(rid)
+
+    def remove(self, wid: str) -> None:
+        rid = self._rid.pop(wid, None)
+        self._last_hb.pop(wid, None)
+        if rid is not None:
+            self._reg.remove(rid)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        snap = self._reg.snapshot()
+        return {
+            wid: snap[rid] for wid, rid in self._rid.items() if rid in snap
+        }
+
+
+# ------------------------------------------------------------- coordinator
+
+
+@dataclasses.dataclass
+class RelayoutRecord:
+    """One elastic re-layout: why, who, and what the recovery cost."""
+
+    epoch: int
+    reason: str
+    lost: Tuple[str, ...]
+    workers: Tuple[str, ...]
+    step: int  # in-flight global step when the layout changed
+    replayed_steps: int
+    replayed_shards: int
+    t_detect: float
+    t_resume: Optional[float] = None
+
+    @property
+    def downtime_s(self) -> float:
+        if self.t_resume is None:
+            return float("nan")
+        return max(0.0, self.t_resume - self.t_detect)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        # None, not NaN: NaN is not strict JSON and breaks downstream parsers
+        d["downtime_s"] = None if self.t_resume is None else self.downtime_s
+        return d
+
+
+class FleetCoordinator:
+    """The training control plane: registry + fold barrier + layout epochs.
+
+    Pure logic + threading (no sockets — :class:`CoordinatorServer` wraps
+    it in HTTP): workers ``join``, ``heartbeat``, and ``submit`` per-shard
+    grads; the coordinator folds when all shards of the in-flight step have
+    arrived and releases the folded update to every blocked submitter. A
+    layout EPOCH versions the assignment: any submit carrying a stale epoch
+    is bounced with the new layout instead of being folded, which is how
+    survivors learn mid-step that a re-layout happened and which shards
+    they now owe."""
+
+    def __init__(
+        self,
+        *,
+        n_shards: int = 4,
+        per_shard_batch: int = 2,
+        seq_len: int = 16,
+        vocab: int = 64,
+        seed: int = 0,
+        total_steps: Optional[int] = None,
+        snapshot_every: int = 5,
+        min_workers: int = 1,
+        lr: float = 1e-3,
+        model: Optional[Dict[str, int]] = None,
+        ckpt_dir: Optional[str] = None,
+        hb_timeout_s: float = 0.75,
+        eject_threshold: int = 3,
+        straggler_factor: float = 3.0,
+        straggler_min_spans: int = 4,
+        shed_stragglers: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.n_shards = int(n_shards)
+        self.per_shard_batch = int(per_shard_batch)
+        self.seq_len = int(seq_len)
+        self.vocab = int(vocab)
+        self.seed = int(seed)
+        self.total_steps = total_steps
+        self.snapshot_every = int(snapshot_every)
+        self.min_workers = int(min_workers)
+        self.lr = float(lr)
+        self.model = dict(model or {"d_model": 32, "n_heads": 2, "n_layers": 2})
+        self.ckpt_dir = ckpt_dir
+        self.clock = clock
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_min_spans = int(straggler_min_spans)
+        self.shed_stragglers = bool(shed_stragglers)
+
+        self.registry = FleetRegistry(
+            clock=clock, hb_timeout_s=hb_timeout_s,
+            eject_threshold=eject_threshold,
+        )
+        self.cv = threading.Condition()
+        self.epoch = 0
+        self.assignment: Dict[str, Tuple[int, ...]] = {}
+        self.committed = -1  # last step whose fold was released
+        self.contribs: Dict[int, List[np.ndarray]] = {}
+        self.loss_by_shard: Dict[int, float] = {}
+        self.folds: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self.loss_history: List[Tuple[int, float]] = []
+        self.relayouts: List[RelayoutRecord] = []
+        self.events: List[Dict[str, Any]] = []
+        self.stopping = False
+        self.done = threading.Event()
+        # obs plane: coordinator fold spans + drained worker spans/offsets
+        self.spans: List[Dict[str, Any]] = []
+        self.worker_spans: Dict[str, List[Dict[str, Any]]] = {}
+        self.worker_offsets: Dict[str, float] = {}
+        self.worker_meta: Dict[str, Dict[str, Any]] = {}
+        self._snapshot_step: Optional[int] = None
+        self._fold_open_t: Optional[float] = None
+        self._last_release_t: Optional[float] = None
+        # peer-bootstrap plumbing: newest uploaded full state + who waits
+        self._state_cache: Optional[Tuple[int, Dict[str, Any]]] = None
+        self._bootstrap_waiters = 0
+        self._stragglers: Dict[str, float] = {}
+
+    # -- membership ---------------------------------------------------------
+
+    def join(
+        self, wid: str, offset_s: float = 0.0, version: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Admit (or re-admit) a worker; returns layout + run config + how
+        to bootstrap state (``init`` | ``peer`` | ``snapshot``)."""
+        with self.cv:
+            if self.stopping:
+                # a (re)join after the run finished: admit nothing, assign
+                # nothing — the worker follows the fold line, sees stop, exits
+                return {
+                    "epoch": self.epoch,
+                    "assignment": {},
+                    "committed": self.committed,
+                    "bootstrap": "none",
+                    "stop": True,
+                    "cfg": {
+                        "n_shards": self.n_shards,
+                        "per_shard_batch": self.per_shard_batch,
+                        "seq_len": self.seq_len,
+                        "vocab": self.vocab,
+                        "seed": self.seed,
+                        "snapshot_every": self.snapshot_every,
+                        "lr": self.lr,
+                        "model": self.model,
+                        "total_steps": self.total_steps,
+                    },
+                }
+            others = [w for w in self.registry.live() if w != wid]
+            rewound = 0
+            if version is not None and not others and version <= self.committed:
+                # the whole fleet died and this worker restored a snapshot:
+                # rewind the fold line to its restore point. Replay from
+                # there is bounded by the snapshot interval — and because
+                # shards are counter-addressed, it re-produces the exact
+                # trajectory rather than an approximation of it.
+                rewound = self.committed + 1 - version
+                log.warning(
+                    "fleet: rewinding committed %d -> %d for snapshot resume "
+                    "of %s (replaying %d step(s))",
+                    self.committed, version - 1, wid, rewound,
+                )
+                self.committed = version - 1
+                self.contribs.clear()
+                self.loss_by_shard.clear()
+                self.folds.clear()
+                self.loss_history = [
+                    e for e in self.loss_history if e[0] < version
+                ]
+            self.registry.register(wid)
+            self.worker_offsets[wid] = float(offset_s)
+            self.worker_spans.setdefault(wid, [])
+            boot = "init"
+            if self.committed >= 0 or version is not None:
+                boot = "peer" if others else ("snapshot" if version is None else "none")
+            self._relayout(
+                reason=("rewind:" if rewound else "join:") + wid,
+                lost=(),
+                replayed_steps=rewound,
+            )
+            self.events.append(
+                {"t": self.clock(), "event": "join", "wid": wid, "boot": boot}
+            )
+            return {
+                "epoch": self.epoch,
+                "assignment": {w: list(s) for w, s in self.assignment.items()},
+                "committed": self.committed,
+                "bootstrap": boot,
+                "cfg": {
+                    "n_shards": self.n_shards,
+                    "per_shard_batch": self.per_shard_batch,
+                    "seq_len": self.seq_len,
+                    "vocab": self.vocab,
+                    "seed": self.seed,
+                    "snapshot_every": self.snapshot_every,
+                    "lr": self.lr,
+                    "model": self.model,
+                    "total_steps": self.total_steps,
+                },
+            }
+
+    def _relayout(
+        self,
+        reason: str,
+        lost: Tuple[str, ...],
+        replayed_steps: Optional[int] = None,
+        assignment: Optional[Dict[str, Tuple[int, ...]]] = None,
+    ) -> None:
+        """Bump the layout epoch and reassign shards over the live set.
+
+        Must be called with ``self.cv`` held. Partial contributions for the
+        in-flight step are KEPT: a shard's grads are identical whoever
+        computed them, so only the shards the lost worker never delivered
+        are replayed — the replay bill is the partial step, not the step."""
+        self.epoch += 1
+        live = self.registry.live()
+        started = self.committed >= 0
+        if not started and len(live) < self.min_workers:
+            self.assignment = {}  # start gate: hold the first fold
+        elif assignment is not None:
+            self.assignment = assignment
+        else:
+            self.assignment = assign_shards(live, self.n_shards)
+        s_cur = self.committed + 1
+        missing = self.n_shards - len(self.contribs)
+        if replayed_steps is None:
+            replayed_steps = 1 if (lost and missing) else 0
+        self.relayouts.append(
+            RelayoutRecord(
+                epoch=self.epoch,
+                reason=reason,
+                lost=tuple(lost),
+                workers=tuple(live),
+                step=s_cur,
+                replayed_steps=int(replayed_steps),
+                replayed_shards=missing if lost else 0,
+                t_detect=self.clock(),
+            )
+        )
+        if self.assignment and self._last_release_t is None:
+            # the start gate just opened: this is when workers can begin
+            # computing, so it anchors the first global step's trace window
+            self._last_release_t = self.clock()
+        log.warning(
+            "fleet: relayout epoch=%d (%s) workers=%s assignment=%s",
+            self.epoch, reason, live, self.assignment,
+        )
+        self.cv.notify_all()
+
+    def _relayout_reply(self) -> Dict[str, Any]:
+        return {
+            "relayout": True,
+            "epoch": self.epoch,
+            "assignment": {w: list(s) for w, s in self.assignment.items()},
+            "committed": self.committed,
+        }
+
+    # -- health plane -------------------------------------------------------
+
+    def heartbeat(self, wid: str, body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """One worker heartbeat. Returns directives, or None when the
+        worker is unknown/removed (HTTP 410 — it must re-join)."""
+        with self.cv:
+            ok = self.registry.heartbeat(
+                wid, {"clock_monotonic": body.get("clock")}
+            )
+            if not ok:
+                self.events.append(
+                    {"t": self.clock(), "event": "late_heartbeat_dropped",
+                     "wid": wid}
+                )
+                return None
+            self.worker_meta[wid] = {
+                "step": body.get("step"),
+                "version": body.get("version"),
+                "snapshot_step": body.get("snapshot_step"),
+                "loader": body.get("loader"),
+            }
+            if body.get("offset_s") is not None:
+                self.worker_offsets[wid] = float(body["offset_s"])
+            if body.get("snapshot_step") is not None:
+                s = int(body["snapshot_step"])
+                self._snapshot_step = max(self._snapshot_step or 0, s)
+            spans = body.get("spans") or []
+            if spans:
+                buf = self.worker_spans.setdefault(wid, [])
+                buf.extend(spans)
+                del buf[:-400]
+            directives: Dict[str, Any] = {}
+            if (
+                self._bootstrap_waiters > 0
+                and self.registry.is_live(wid)
+                and (self._state_cache is None
+                     or self._state_cache[0] < self.committed + 1)
+                and body.get("version") == self.committed + 1
+            ):
+                directives["upload_state"] = self.committed + 1
+            if self.stopping:
+                directives["stop"] = True
+            return directives
+
+    def sweep(self) -> List[Tuple[str, str]]:
+        """Heartbeat-silence sweep; drives loss-triggered re-layouts and
+        straggler detection. Called periodically by the server loop (or
+        directly by tests with a fake clock)."""
+        with self.cv:
+            events = self.registry.sweep()
+            lost = [wid for ev, wid in events if ev == "ejected"]
+            for wid in lost:
+                self.registry.cordon(wid)  # out of layout until re-register
+                self.events.append(
+                    {"t": self.clock(), "event": "worker_lost", "wid": wid}
+                )
+            if lost and not self.stopping:
+                # post-stop exits are workers leaving on cue, not failures:
+                # re-layouting for them would fabricate relayout records
+                self._relayout(
+                    reason="lost:" + ",".join(lost), lost=tuple(lost)
+                )
+            self._check_stragglers()
+            return events
+
+    def _check_stragglers(self) -> None:
+        """Fleet-relative straggler detection over the stitched span groups
+        the PR 15 obs plane defines (must hold ``self.cv``)."""
+        groups = [
+            {
+                "process": wid,
+                "offset_s": self.worker_offsets.get(wid, 0.0),
+                "spans": list(self.worker_spans.get(wid, ())),
+            }
+            for wid in self.registry.live()
+        ]
+        report = detect_stragglers(
+            groups,
+            span_name="compute",
+            factor=self.straggler_factor,
+            min_spans=self.straggler_min_spans,
+        )
+        for wid, info in report.items():
+            if not info["straggler"] or wid in self._stragglers:
+                continue
+            self._stragglers[wid] = info["ratio"]
+            self.events.append(
+                {"t": self.clock(), "event": "straggler_detected",
+                 "wid": wid, "ratio": round(info["ratio"], 3)}
+            )
+            log.warning(
+                "fleet: straggler %s (%.1fx fleet median)", wid, info["ratio"]
+            )
+            if self.shed_stragglers and len(self.assignment.get(wid, ())) > 1:
+                self._shed_shard(wid, report)
+
+    def _shed_shard(self, slow: str, report: Dict[str, Dict[str, Any]]) -> None:
+        """Load-driven re-layout: move ONE shard off a straggler onto the
+        fastest worker. Trajectory-invariant by construction (shards are
+        the data, workers are just where they compute)."""
+        fast = min(
+            (w for w in self.assignment if w != slow),
+            key=lambda w: report.get(w, {}).get("mean_s", float("inf")),
+            default=None,
+        )
+        if fast is None:
+            return
+        new = {w: list(s) for w, s in self.assignment.items()}
+        moved = new[slow].pop()
+        new[fast].append(moved)
+        self._relayout(
+            reason=f"shed:{slow}->{fast}",
+            lost=(),
+            replayed_steps=0,
+            assignment={w: tuple(sorted(s)) for w, s in new.items()},
+        )
+
+    # -- fold barrier -------------------------------------------------------
+
+    def submit(
+        self,
+        wid: str,
+        epoch: int,
+        step: int,
+        shard_docs: Dict[str, Dict[str, Any]],
+        losses: Dict[str, float],
+        timeout: float = 10.0,
+    ) -> Dict[str, Any]:
+        """Fold-barrier entry: accept per-shard grads, block until the fold
+        for ``step`` releases (or the epoch moves / the run stops)."""
+        deadline = self.clock() + timeout
+        with self.cv:
+            if not self.registry.is_live(wid):
+                return {"gone": True}
+            if (
+                not self.stopping
+                and epoch == self.epoch
+                and step == self.committed + 1
+            ):
+                now = self.clock()
+                if self._fold_open_t is None:
+                    self._fold_open_t = now
+                for sid_s, doc in shard_docs.items():
+                    sid = int(sid_s)
+                    if 0 <= sid < self.n_shards and sid not in self.contribs:
+                        self.contribs[sid] = decode_leaves(doc)
+                        self.loss_by_shard[sid] = float(losses[sid_s])
+                if len(self.contribs) == self.n_shards and self.assignment:
+                    self._complete_fold()
+            while True:
+                if not self.registry.is_live(wid):
+                    return {"gone": True}
+                if step <= self.committed:
+                    # fold-before-stop: the LAST fold of the run both commits
+                    # and sets stopping — workers must still receive it, or
+                    # the final optimizer step exists only on the coordinator
+                    fold = self.folds.get(step)
+                    if fold is not None:
+                        return {"ok": True, "step": step, **fold}
+                    return {"stale": True, "committed": self.committed}
+                if self.stopping:
+                    return {"stop": True, "committed": self.committed}
+                if epoch != self.epoch:
+                    return self._relayout_reply()
+                if self.clock() >= deadline:
+                    return {"retry": True}
+                self.cv.wait(timeout=0.05)
+
+    def _complete_fold(self) -> None:
+        """All shards in: fold in shard order, release, commit (cv held)."""
+        s = self.committed + 1
+        # the step's trace root spans the whole global step: from the
+        # previous release (when workers could start computing this step)
+        # to this release — worker compute/post/apply spans nest inside it
+        t0 = self._last_release_t
+        if t0 is None:
+            t0 = self._fold_open_t if self._fold_open_t is not None else self.clock()
+        folded = fold_shard_leaves(self.contribs)
+        scaled = scale_leaves(folded, self.n_shards)
+        loss = fold_losses(self.loss_by_shard, self.n_shards)
+        self.folds[s] = {"grads": encode_leaves(scaled), "loss": loss}
+        while len(self.folds) > FOLD_CACHE_STEPS:
+            self.folds.popitem(last=False)
+        self.committed = s
+        self.loss_history.append((s, loss))
+        self.contribs = {}
+        self.loss_by_shard = {}
+        t1 = self.clock()
+        self._fold_open_t = None
+        self._last_release_t = t1
+        self.spans.append(
+            {"track": f"step-{s}", "name": "route", "t0": t0, "t1": t1,
+             "attrs": {"step": s, "loss": loss}}
+        )
+        del self.spans[:-600]
+        for rec in self.relayouts:
+            if rec.t_resume is None:
+                rec.t_resume = t1
+        if self.total_steps is not None and s >= self.total_steps - 1:
+            self.stopping = True
+            self.done.set()
+        self.cv.notify_all()
+
+    def get_fold(self, step: int, timeout: float = 10.0) -> Dict[str, Any]:
+        """Catch-up path for shardless/lagging workers: the fold for
+        ``step``, long-polling while it is still in flight. ``evicted``
+        means the worker is too far behind the cache — re-bootstrap."""
+        deadline = self.clock() + timeout
+        with self.cv:
+            while True:
+                if step <= self.committed:
+                    fold = self.folds.get(step)
+                    if fold is None:
+                        return {"evicted": True, "committed": self.committed}
+                    return {"ok": True, "step": step, **fold}
+                if self.stopping:
+                    return {"stop": True, "committed": self.committed}
+                if self.clock() >= deadline:
+                    return {"pending": True, "committed": self.committed}
+                self.cv.wait(timeout=0.05)
+
+    # -- peer state bootstrap ----------------------------------------------
+
+    def put_state(self, wid: str, version: int, state: Dict[str, Any]) -> bool:
+        with self.cv:
+            if self._state_cache is None or version >= self._state_cache[0]:
+                self._state_cache = (int(version), state)
+                self.events.append(
+                    {"t": self.clock(), "event": "state_uploaded",
+                     "wid": wid, "version": int(version)}
+                )
+                self.cv.notify_all()
+                return True
+            return False
+
+    def get_bootstrap(self, timeout: float = 10.0) -> Dict[str, Any]:
+        """Long-poll a peer state upload at the current fold line. The
+        requesting worker then catches up through the fold cache if the
+        line moved while it was downloading."""
+        deadline = self.clock() + timeout
+        with self.cv:
+            self._bootstrap_waiters += 1
+            try:
+                while True:
+                    if self.committed < 0 and self._state_cache is None:
+                        return {"kind": "init"}
+                    cache = self._state_cache
+                    if cache is not None and cache[0] >= self.committed + 1 - (
+                        FOLD_CACHE_STEPS - 1
+                    ):
+                        return {
+                            "kind": "peer",
+                            "version": cache[0],
+                            "state": cache[1],
+                        }
+                    if self.clock() >= deadline:
+                        return {"pending": True}
+                    self.cv.wait(timeout=0.05)
+            finally:
+                self._bootstrap_waiters -= 1
+
+    # -- observability ------------------------------------------------------
+
+    def trace_groups(self, step: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Span groups in ``obs.fleet.stitch_spans`` shape — coordinator as
+        the reference clock (offset 0), workers shifted by their reported
+        offsets. ``step`` filters to one global step's track."""
+        def keep(s):
+            return step is None or s.get("track") == f"step-{step}"
+
+        with self.cv:
+            groups = [
+                {
+                    "process": "coordinator",
+                    "offset_s": 0.0,
+                    "spans": [s for s in self.spans if keep(s)],
+                }
+            ]
+            for wid in sorted(self.worker_spans):
+                groups.append(
+                    {
+                        "process": wid,
+                        "offset_s": self.worker_offsets.get(wid, 0.0),
+                        "spans": [
+                            s for s in self.worker_spans[wid] if keep(s)
+                        ],
+                    }
+                )
+            return groups
+
+    def trace_doc(self, step: Optional[int] = None) -> Dict[str, Any]:
+        return stitch_spans(self.trace_groups(step))
+
+    def status(self) -> Dict[str, Any]:
+        with self.cv:
+            return {
+                "epoch": self.epoch,
+                "committed": self.committed,
+                "stopping": self.stopping,
+                "assignment": {w: list(s) for w, s in self.assignment.items()},
+                "workers": self.registry.snapshot(),
+                "worker_meta": dict(self.worker_meta),
+                "loss_history": [[s, l] for s, l in self.loss_history],
+                "relayouts": [r.to_dict() for r in self.relayouts],
+                "events": list(self.events),
+                "stragglers": dict(self._stragglers),
+                "snapshot_step": self._snapshot_step,
+            }
+
+    def bench(self, chaos: Sequence[str] = (), bitwise_rejoin: Optional[bool] = None) -> Dict[str, Any]:
+        """The BENCH_fleet_train.json document (schema:
+        ``FLEET_BENCH_REQUIRED_KEYS``)."""
+        with self.cv:
+            loss_rl = [
+                r for r in self.relayouts if r.lost or "rewind" in r.reason
+            ]
+            downtime = sum(
+                r.downtime_s for r in loss_rl if r.t_resume is not None
+            )
+            return {
+                "metric": "fleet_train_relayout",
+                "workers": len(self.registry.snapshot()),
+                "n_shards": self.n_shards,
+                "steps": self.committed + 1,
+                "relayouts": [r.to_dict() for r in self.relayouts],
+                "replayed_steps": sum(r.replayed_steps for r in loss_rl),
+                "replayed_shards": sum(r.replayed_shards for r in loss_rl),
+                "relayout_downtime_s": round(downtime, 6),
+                "snapshot_every": self.snapshot_every,
+                "chaos": list(chaos),
+                "bitwise_rejoin": bitwise_rejoin,
+                "loss_first": self.loss_history[0][1] if self.loss_history else None,
+                "loss_last": self.loss_history[-1][1] if self.loss_history else None,
+                "platform": "cpu",
+            }
+
+    def stop(self) -> None:
+        with self.cv:
+            self.stopping = True
+            self.done.set()
+            self.cv.notify_all()
+
+
+# ----------------------------------------------------------- HTTP control plane
+
+
+class _CoordinatorHandler(BaseHTTPRequestHandler):
+    coord: FleetCoordinator  # set by CoordinatorServer
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # stdlib default spams stderr
+        log.debug("fleet-http: " + fmt, *args)
+
+    def _json(self, code: int, obj: Dict[str, Any]) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        if n <= 0:
+            return {}
+        return json.loads(self.rfile.read(n).decode())
+
+    def do_GET(self):  # noqa: N802 — stdlib handler API
+        parts = urlsplit(self.path)
+        q = {k: v[0] for k, v in parse_qs(parts.query).items()}
+        if parts.path == "/clock":
+            self._json(200, {"clock_monotonic": time.monotonic()})
+        elif parts.path == "/status":
+            self._json(200, self.coord.status())
+        elif parts.path == "/fold":
+            # short server-side long-poll: a pending reply doubles as the
+            # shardless worker's cue to refresh its layout
+            self._json(
+                200, self.coord.get_fold(int(q.get("step", -1)), timeout=1.0)
+            )
+        elif parts.path == "/bootstrap":
+            self._json(200, self.coord.get_bootstrap())
+        elif parts.path == "/trace":
+            step = int(q["step"]) if "step" in q else None
+            self._json(200, self.coord.trace_doc(step))
+        else:
+            self._json(404, {"error": f"unknown path {parts.path}"})
+
+    def do_POST(self):  # noqa: N802 — stdlib handler API
+        path = urlsplit(self.path).path
+        body = self._body()
+        if path == "/join":
+            self._json(
+                200,
+                self.coord.join(
+                    str(body["wid"]),
+                    offset_s=float(body.get("offset_s", 0.0)),
+                    version=(
+                        int(body["version"]) if body.get("version") is not None
+                        else None
+                    ),
+                ),
+            )
+        elif path == "/heartbeat":
+            directives = self.coord.heartbeat(str(body["wid"]), body)
+            if directives is None:
+                self._json(410, {"gone": True})
+            else:
+                self._json(200, {"directives": directives})
+        elif path == "/grads":
+            out = self.coord.submit(
+                str(body["wid"]),
+                int(body["epoch"]),
+                int(body["step"]),
+                body.get("shards", {}),
+                body.get("losses", {}),
+            )
+            self._json(410 if out.get("gone") else 200, out)
+        elif path == "/state":
+            ok = self.coord.put_state(
+                str(body["wid"]), int(body["version"]), body["state"]
+            )
+            self._json(200, {"accepted": ok})
+        elif path == "/stop":
+            self.coord.stop()
+            self._json(200, {"stopping": True})
+        else:
+            self._json(404, {"error": f"unknown path {path}"})
+
+
+class CoordinatorServer:
+    """HTTP wrapper + heartbeat-sweeper thread around a FleetCoordinator."""
+
+    def __init__(
+        self,
+        coord: FleetCoordinator,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        sweep_interval_s: float = 0.15,
+    ):
+        self.coord = coord
+        handler = type(
+            "_BoundHandler", (_CoordinatorHandler,), {"coord": coord}
+        )
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self.sweep_interval_s = sweep_interval_s
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self.httpd.serve_forever, daemon=True),
+            threading.Thread(target=self._sweep_loop, daemon=True),
+        ]
+
+    def start(self) -> "CoordinatorServer":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.coord.sweep()
+            except Exception:
+                # the sweeper must outlive any one bad sweep: losing it
+                # silently would disable death detection for the whole run
+                log.exception("fleet: sweep failed (continuing)")
+            self._stop.wait(self.sweep_interval_s)
+
+    def close(self) -> None:
+        self._stop.set()
+        self.coord.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self) -> "CoordinatorServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# --------------------------------------------------------------- HTTP client
+
+
+def http_json(
+    base: str,
+    path: str,
+    payload: Optional[Dict[str, Any]] = None,
+    timeout: float = 30.0,
+) -> Tuple[int, Dict[str, Any]]:
+    """One JSON request to the coordinator. Returns ``(status, body)`` —
+    HTTP errors with JSON bodies (409/410 protocol replies) are DATA here,
+    not exceptions; transport errors raise for the caller's retry loop."""
+    url = base.rstrip("/") + path
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url,
+        data=data,
+        headers={"Content-Type": "application/json"},
+        method="POST" if data is not None else "GET",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode() if e.fp else "{}"
+        try:
+            return e.code, json.loads(raw or "{}")
+        except json.JSONDecodeError:
+            return e.code, {"error": raw}
+
+
+def estimate_offset_to(base: str, timeout: float = 5.0) -> float:
+    """This process's clock offset relative to the coordinator (worker
+    clock minus coordinator clock), NTP-style from one ``/clock`` round
+    trip — the group ``offset_s`` the PR 15 stitcher expects."""
+    t0 = time.monotonic()
+    _, body = http_json(base, "/clock", timeout=timeout)
+    t1 = time.monotonic()
+    coord_minus_us, _, _ = estimate_clock_offset(
+        float(body["clock_monotonic"]), t0, t1
+    )
+    return -coord_minus_us
+
+
+# -------------------------------------------------------------- fleet worker
+
+
+class FleetWorker:
+    """One DP worker process: compute owned shards, push grads, apply the
+    released fold, heartbeat, snapshot when designated saver.
+
+    jax is imported lazily (coordinator-side imports of this module stay
+    backend-free). All state-mutating jax calls live on the main thread;
+    the heartbeat thread only reads the published numpy copy of the state
+    (peer-bootstrap uploads must not race the step loop)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        wid: str,
+        ckpt_dir: Optional[str] = None,
+        resume: bool = False,
+        chaos=None,
+        hb_interval_s: float = 0.2,
+        print_losses: bool = True,
+    ):
+        self.base = base_url
+        self.wid = wid
+        self.ckpt_dir = ckpt_dir
+        self.resume = resume
+        self.chaos = chaos
+        self.hb_interval_s = hb_interval_s
+        self.print_losses = print_losses
+        self.version = 0  # state version = next global step to compute
+        self.epoch = 0
+        self.assignment: Dict[str, List[int]] = {}
+        self.cfg: Dict[str, Any] = {}
+        self.offset_s = 0.0
+        self.snapshot_step: Optional[int] = None
+        self._lock = threading.Lock()
+        self._spans: List[Dict[str, Any]] = []
+        self._pub: Optional[Tuple[int, Dict[str, Any]]] = None
+        self._stop = threading.Event()
+        self._shard_cache: Dict[Tuple[int, int], Tuple[float, Dict[str, Any]]] = {}
+        self._ckpt = None
+        self._losses: List[Tuple[int, float]] = []
+
+    # -- jax-side construction ---------------------------------------------
+
+    def _build(self) -> None:
+        import jax
+        import optax
+
+        from zero_transformer_tpu.config import ModelConfig
+        from zero_transformer_tpu.models.gpt import Transformer
+
+        c = self.cfg
+        mc = ModelConfig(
+            vocab_size=c["vocab"],
+            d_model=c["model"]["d_model"],
+            n_heads=c["model"]["n_heads"],
+            n_layers=c["model"]["n_layers"],
+            max_seq_len=c["seq_len"],
+            dropout=0.0,
+        )
+        model = Transformer(cfg=mc)
+        sample = np.zeros((c["per_shard_batch"], c["seq_len"]), np.int32)
+        params = model.init(jax.random.PRNGKey(c["seed"]), sample)["params"]
+        tx = optax.adam(c["lr"])
+        opt_state = tx.init(params)
+
+        def loss_fn(p, batch):
+            _, loss = model.apply({"params": p}, batch, labels=batch)
+            return loss
+
+        self._grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+        def apply_fn(p, o, g):
+            updates, o2 = tx.update(g, o, p)
+            return optax.apply_updates(p, updates), o2
+
+        self._apply_fn = jax.jit(apply_fn)
+        self._jax = jax
+        self._tx = tx
+        self.params = params
+        self.opt_state = opt_state
+        _, self._params_def = jax.tree_util.tree_flatten(params)
+        _, self._opt_def = jax.tree_util.tree_flatten(opt_state)
+        self._publish()
+
+    def _param_leaves(self) -> List[np.ndarray]:
+        return [np.asarray(l) for l in self._jax.tree_util.tree_leaves(self.params)]
+
+    def _publish(self) -> None:
+        """Numpy snapshot of (version, params, opt) for the heartbeat
+        thread to serve on an ``upload_state`` directive."""
+        doc = {
+            "params": encode_leaves(self._param_leaves()),
+            "opt": encode_leaves(
+                [np.asarray(l) for l in self._jax.tree_util.tree_leaves(self.opt_state)]
+            ),
+        }
+        with self._lock:
+            self._pub = (self.version, doc)
+
+    def _adopt_state(self, version: int, doc: Dict[str, Any]) -> None:
+        self.params = self._jax.tree_util.tree_unflatten(
+            self._params_def, decode_leaves(doc["params"])
+        )
+        self.opt_state = self._jax.tree_util.tree_unflatten(
+            self._opt_def, decode_leaves(doc["opt"])
+        )
+        self.version = int(version)
+        self._publish()
+
+    # -- snapshots (PR 5 machinery) ----------------------------------------
+
+    def _ckpt_mgr(self):
+        if self._ckpt is None:
+            from zero_transformer_tpu.checkpoint import CheckpointManager
+
+            self._ckpt = CheckpointManager(
+                self.ckpt_dir,
+                save_frequency=max(1, int(self.cfg.get("snapshot_every", 5))),
+                async_save=False,
+            )
+        return self._ckpt
+
+    def _save_snapshot(self) -> None:
+        import jax.numpy as jnp
+
+        from zero_transformer_tpu.parallel.zero import TrainState
+
+        c = self.cfg
+        state = TrainState(
+            step=jnp.asarray(self.version, jnp.int32),
+            params=self.params,
+            opt_state=self.opt_state,
+        )
+        meta = {
+            "loader": {"steps_consumed": self.version},
+            "schedule": {
+                "batch_size": c["n_shards"] * c["per_shard_batch"],
+                "train_context": c["seq_len"],
+                "accum_steps": 1,
+            },
+            "fleet": {"wid": self.wid, "epoch": self.epoch,
+                      "n_shards": c["n_shards"]},
+        }
+        if self._ckpt_mgr().save(self.version, state, meta=meta, force=True):
+            self._ckpt_mgr().wait()
+            self.snapshot_step = self.version
+            log.info("fleet[%s]: snapshot at step %d", self.wid, self.version)
+
+    def restore_snapshot(self) -> Optional[int]:
+        """Verified restore (digest manifest; PR 5) + loader-position remap
+        through the trainer's elastic-resume seam. Returns the restored
+        version, or None when the directory holds no usable snapshot."""
+        import jax.numpy as jnp
+
+        from zero_transformer_tpu.parallel.zero import TrainState
+        from zero_transformer_tpu.training.trainer import remap_loader_state
+
+        mgr = self._ckpt_mgr()
+        if mgr.latest_step() is None:
+            return None
+        template = TrainState(
+            step=jnp.asarray(0, jnp.int32),
+            params=self.params,
+            opt_state=self.opt_state,
+        )
+        state, meta, _report = mgr.restore_verified(template)
+        c = self.cfg
+        loader = remap_loader_state(
+            meta,
+            batch_size=c["n_shards"] * c["per_shard_batch"],
+            train_context=c["seq_len"],
+            accum_steps=1,
+        )
+        version = int(
+            (loader or {}).get("steps_consumed", int(np.asarray(state.step)))
+        )
+        self.params = state.params
+        self.opt_state = state.opt_state
+        self.version = version
+        self.snapshot_step = version
+        self._publish()
+        return version
+
+    # -- wire helpers -------------------------------------------------------
+
+    def _span(self, name: str, t0: float, t1: float, **attrs) -> None:
+        attrs.setdefault("wid", self.wid)
+        with self._lock:
+            self._spans.append(
+                {"track": f"step-{self.version}", "name": name,
+                 "t0": t0, "t1": t1, "attrs": attrs}
+            )
+            del self._spans[:-200]
+
+    def _heartbeat_once(self) -> None:
+        if self.chaos is not None and self.chaos.drop_heartbeat(self.version):
+            return
+        with self._lock:
+            spans, self._spans = self._spans, []
+            pub = self._pub
+        body = {
+            "wid": self.wid,
+            "step": self.version,
+            "version": self.version,
+            "snapshot_step": self.snapshot_step,
+            "loader": {"steps_consumed": self.version},
+            "clock": time.monotonic(),
+            "offset_s": self.offset_s,
+            "spans": spans,
+        }
+        try:
+            status, out = http_json(
+                self.base, "/heartbeat", body, timeout=5.0
+            )
+        except (OSError, urllib.error.URLError) as e:
+            log.warning("fleet[%s]: heartbeat failed: %s", self.wid, e)
+            return
+        if status == 410:
+            return  # declared dead; the main loop will hit gone and rejoin
+        directives = out.get("directives") or {}
+        want = directives.get("upload_state")
+        if want is not None and pub is not None and pub[0] == int(want):
+            http_json(
+                self.base, "/state",
+                {"wid": self.wid, "version": pub[0], "state": pub[1]},
+                timeout=10.0,
+            )
+        if directives.get("stop"):
+            self._stop.set()
+
+    def _hb_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._heartbeat_once()
+            except Exception:
+                # losing the heartbeat thread IS worker death to the fleet:
+                # log and keep beating rather than silently going dark
+                log.exception("fleet[%s]: heartbeat loop error", self.wid)
+            self._stop.wait(self.hb_interval_s)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _join(self, version: Optional[int] = None) -> Dict[str, Any]:
+        self.offset_s = estimate_offset_to(self.base)
+        _, out = http_json(
+            self.base, "/join",
+            {"wid": self.wid, "offset_s": self.offset_s, "version": version},
+        )
+        self.epoch = int(out["epoch"])
+        self.assignment = out["assignment"]
+        self.cfg = out["cfg"]
+        return out
+
+    def _bootstrap_peer(self) -> None:
+        while not self._stop.is_set():
+            _, out = http_json(self.base, "/bootstrap", timeout=30.0)
+            if out.get("kind") == "peer":
+                self._adopt_state(out["version"], out["state"])
+                log.info(
+                    "fleet[%s]: peer bootstrap at version %d",
+                    self.wid, self.version,
+                )
+                return
+            if out.get("kind") == "init":
+                return
+            time.sleep(0.05)
+
+    def _catch_up_or_rebootstrap(self, committed: int) -> None:
+        """Apply cached folds from our version up to the fold line; if the
+        cache no longer reaches back far enough, take a fresh peer state."""
+        while self.version <= committed and not self._stop.is_set():
+            _, out = http_json(
+                self.base, f"/fold?step={self.version}", timeout=30.0
+            )
+            if out.get("ok"):
+                self._apply_fold(out)
+            elif out.get("evicted"):
+                self._bootstrap_peer()
+                return
+            elif out.get("stop"):
+                self._stop.set()
+                return
+            else:  # pending
+                time.sleep(0.02)
+
+    def _apply_fold(self, fold: Dict[str, Any]) -> None:
+        t0 = time.monotonic()
+        grads = self._jax.tree_util.tree_unflatten(
+            self._params_def, decode_leaves(fold["grads"])
+        )
+        self.params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, grads
+        )
+        self._jax.block_until_ready(self.params)
+        step = self.version
+        self._losses.append((step, float(fold["loss"])))
+        if self.print_losses:
+            print(f"LOSS step={step} {float(fold['loss']):.6f}", flush=True)
+        self.version += 1
+        self._span("apply", t0, time.monotonic(), step=step)
+        self._publish()
+        self._shard_cache = {
+            k: v for k, v in self._shard_cache.items() if k[0] >= self.version
+        }
+        c = self.cfg
+        if (
+            self.ckpt_dir
+            and self.version % max(1, int(c["snapshot_every"])) == 0
+            and self.wid == min(self.assignment or {self.wid: ()})
+        ):
+            self._save_snapshot()
+        if self.chaos is not None:
+            self.chaos.on_step(self.version)
+
+    def _compute_shard(self, step: int, sid: int) -> Tuple[float, Dict[str, Any]]:
+        key = (step, sid)
+        if key in self._shard_cache:
+            return self._shard_cache[key]
+        c = self.cfg
+        t0 = time.monotonic()
+        if self.chaos is not None:
+            delay = self.chaos.compute_delay(step)
+            if delay > 0:
+                time.sleep(delay)
+        batch = shard_batch(
+            c["seed"], step, sid, c["per_shard_batch"], c["seq_len"], c["vocab"]
+        )
+        loss, grads = self._grad_fn(self.params, batch)
+        leaves = [np.asarray(l) for l in self._jax.tree_util.tree_leaves(grads)]
+        out = (float(np.float32(loss)), encode_leaves(leaves))
+        self._shard_cache[key] = out
+        self._span("compute", t0, time.monotonic(), shard=sid, step=step)
+        return out
+
+    def run(self) -> int:
+        """Join, bootstrap, train until the coordinator stops the run.
+        Returns the number of optimizer steps this process applied."""
+        out = self._join()
+        if out.get("stop"):
+            return 0  # run already over; nothing to bootstrap or compute
+        # heartbeat BEFORE the jax build: compiling the model takes longer
+        # than the death timeout, and a worker mid-compile is slow, not dead
+        hb = threading.Thread(target=self._hb_loop, daemon=True)
+        hb.start()
+        self._build()
+        applied_from = self.version
+        if out["bootstrap"] == "snapshot" or (self.resume and self.ckpt_dir):
+            restored = self.restore_snapshot() if self.ckpt_dir else None
+            if restored is not None:
+                # re-join carrying the restored version: the coordinator
+                # rewinds the fold line to it when we are the sole survivor
+                out = self._join(version=restored)
+                applied_from = self.version
+        if out["bootstrap"] == "peer":
+            self._bootstrap_peer()
+            applied_from = self.version
+        try:
+            self._run_loop()
+        finally:
+            self._stop.set()
+            hb.join(timeout=2.0)
+            try:
+                # final span flush: spans ride heartbeats, and a clean exit
+                # lands within one hb interval of the last steps — without
+                # this the trace tail of the run is coordinator-only
+                self._heartbeat_once()
+            except Exception:
+                log.exception("fleet[%s]: final span flush failed", self.wid)
+            if self._ckpt is not None:
+                self._ckpt.close()
+        return self.version - applied_from
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            mine = [int(s) for s in self.assignment.get(self.wid, [])]
+            step = self.version
+            if not mine:
+                # shardless (fleet larger than shard count, or start gate):
+                # follow the fold line
+                _, out = http_json(
+                    self.base, f"/fold?step={step}", timeout=30.0
+                )
+                if out.get("ok"):
+                    self._apply_fold(out)
+                elif out.get("stop"):
+                    self._stop.set()
+                elif out.get("evicted"):
+                    self._bootstrap_peer()
+                else:
+                    self._refresh_layout()
+                continue
+            shards: Dict[str, Any] = {}
+            losses: Dict[str, float] = {}
+            for sid in mine:
+                loss, doc = self._compute_shard(step, sid)
+                shards[str(sid)] = doc
+                losses[str(sid)] = loss
+            t0 = time.monotonic()
+            try:
+                status, out = http_json(
+                    self.base, "/grads",
+                    {
+                        "wid": self.wid,
+                        "epoch": self.epoch,
+                        "step": step,
+                        "shards": shards,
+                        "losses": losses,
+                    },
+                    timeout=30.0,
+                )
+            except (OSError, urllib.error.URLError) as e:
+                log.warning("fleet[%s]: grads post failed: %s", self.wid, e)
+                time.sleep(0.1)
+                continue
+            self._span("post", t0, time.monotonic(), step=step)
+            if status == 410 or out.get("gone"):
+                self._rejoin()
+            elif out.get("relayout"):
+                self.epoch = int(out["epoch"])
+                self.assignment = out["assignment"]
+            elif out.get("stop"):
+                self._stop.set()
+            elif out.get("stale"):
+                self._catch_up_or_rebootstrap(int(out["committed"]))
+            elif out.get("ok"):
+                self._apply_fold(out)
+            # retry: loop again (cached shards make the re-post cheap)
+
+    def _refresh_layout(self) -> None:
+        _, status = http_json(self.base, "/status", timeout=10.0)
+        self.epoch = int(status["epoch"])
+        self.assignment = {
+            w: list(s) for w, s in status["assignment"].items()
+        }
+
+    def _rejoin(self) -> None:
+        """Declared dead (heartbeat blackhole / SIGSTOP resume): re-register
+        under the same id — the registry gives us a FRESH row — then close
+        any fold gap that opened while we were out."""
+        log.warning(
+            "fleet[%s]: declared dead by coordinator, rejoining", self.wid
+        )
+        out = self._join()
+        if out.get("stop"):
+            self._stop.set()
+            return
+        if int(out["committed"]) >= self.version:
+            self._catch_up_or_rebootstrap(int(out["committed"]))
