@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos disagg-chaos chaos-fleet obs bench bench-watch serve-bench train-bench kernel-bench tune tune-smoke e2e-watch fmt fmt-check dryrun lint
+.PHONY: test test-full chaos elastic-chaos serve-chaos router-chaos disagg-chaos tenant-chaos chaos-fleet obs bench bench-watch serve-bench tenant-bench train-bench kernel-bench tune tune-smoke e2e-watch fmt fmt-check dryrun lint
 
 # Invariant lint lane (ISSUE 10): graftlint's repo-specific AST rules +
 # the suppression audit over the whole tree. Pure stdlib — no jax import,
@@ -84,6 +84,31 @@ chaos-fleet:
 # migration parity, autoscaler logic) are un-marked and run in the quick lane.
 disagg-chaos:
 	$(PY) -m pytest tests/test_serving_disagg.py -q -m chaos $(PYTEST_ARGS)
+
+# Tenant-isolation fault-injection lane (ISSUE 18): the multi-tenant flood
+# proof (one tenant floods a real 2-replica QoS fleet with batch work while
+# a gold tenant's trickle must ALL complete with zero dropped streams and
+# every flood rejection retryable with a Retry-After) plus the slow_client
+# chaos case (a stalled SSE consumer hits its bounded emit buffer and ends
+# retryably; the concurrent healthy stream stays byte-identical). The fast
+# deterministic QoS cases (token buckets, DWRR fairness, brownout ladder,
+# floors, preemption) are un-marked and run in the quick lane.
+tenant-chaos:
+	$(PY) -m pytest tests/test_qos.py -q -m chaos $(PYTEST_ARGS)
+
+# Tenant-isolation bench (ISSUE 18): the gold-trickle A/B under a hostile
+# batch flood on a real 2-replica QoS fleet -> BENCH_tenant.json (gold p99
+# ratio graded on accelerators only — on a shared-core CPU box the flood
+# steals the gold replica's cycles whatever the admission plane does;
+# correctness graded everywhere). Schema pinned by tests/test_serve_bench.py.
+tenant-bench:
+	@cp BENCH_tenant.json /tmp/_serve_tenant_baseline.json 2>/dev/null || true
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_loadgen.py --tenant-flood
+	@if [ -f /tmp/_serve_tenant_baseline.json ]; then \
+		$(PY) scripts/serve_bench_guard.py /tmp/_serve_tenant_baseline.json BENCH_tenant.json; \
+	else \
+		echo "serve-bench-guard: no committed tenant baseline; skipping"; \
+	fi
 
 # Observability lane (ISSUE 7 + ISSUE 15): the obs test files (span-tree
 # parity over every request outcome, Prometheus exposition conformance

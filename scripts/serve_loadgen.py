@@ -128,6 +128,27 @@ def parse_args(argv=None):
                    help="decode-heavy background streams per flood arm")
     p.add_argument("--flood-requests", type=int, default=3,
                    help="long-prompt flood arrivals per arm")
+    p.add_argument("--tenant-flood", action="store_true",
+                   help="tenant-isolation A/B (-> BENCH_tenant.json): a "
+                        "gold tenant's steady trickle alone vs the same "
+                        "trickle while a hostile tenant floods the 2-replica "
+                        "QoS fleet with batch work; proof is the gold p99 "
+                        "ratio within --tenant-isolation-factor, zero "
+                        "dropped streams, and every flood rejection "
+                        "retryable with a Retry-After")
+    p.add_argument("--tenant-gold-requests", type=int, default=8,
+                   help="gold trickle length per tenant-flood arm")
+    p.add_argument("--tenant-flood-clients", type=int, default=4,
+                   help="hostile batch-tenant client threads")
+    p.add_argument("--tenant-batch-rate", type=float, default=20.0,
+                   help="batch-class token-bucket refill rate (tokens/s) "
+                        "for the tenant-flood fleet")
+    p.add_argument("--tenant-batch-burst", type=float, default=40.0,
+                   help="batch-class token-bucket burst for the "
+                        "tenant-flood fleet")
+    p.add_argument("--tenant-isolation-factor", type=float, default=5.0,
+                   help="max allowed gold e2e-p99 ratio, flood arm vs "
+                        "baseline arm (CPU-noise headroom included)")
     p.add_argument("--router", action="store_true",
                    help="fleet-router mode: spawn N in-process PACED stub "
                         "replicas (fixed inter-token interval — models "
@@ -550,7 +571,8 @@ def _platform_block() -> dict:
     }
 
 
-def _sse_collect(port: int, body: dict, timeout: float = 120.0):
+def _sse_collect(port: int, body: dict, timeout: float = 120.0,
+                 headers: dict = None):
     """Minimal SSE client against the router: returns (token_ids, done_event)
     for streams, or (tokens, doc) for JSON rejections."""
     import http.client
@@ -559,7 +581,7 @@ def _sse_collect(port: int, body: dict, timeout: float = 120.0):
     try:
         conn.request(
             "POST", "/generate", json.dumps(body),
-            {"Content-Type": "application/json"},
+            {"Content-Type": "application/json", **(headers or {})},
         )
         resp = conn.getresponse()
         if "text/event-stream" not in resp.getheader("Content-Type", ""):
@@ -930,7 +952,8 @@ def _pcts(values, qs=(50, 99)):
     return out
 
 
-def _sse_timed(port: int, body: dict, timeout: float = 600.0):
+def _sse_timed(port: int, body: dict, timeout: float = 600.0,
+               headers: dict = None):
     """SSE client recording each token's ARRIVAL time: returns
     (ids, stamps, done_event)."""
     import http.client
@@ -939,7 +962,7 @@ def _sse_timed(port: int, body: dict, timeout: float = 600.0):
     try:
         conn.request(
             "POST", "/generate", json.dumps(body),
-            {"Content-Type": "application/json"},
+            {"Content-Type": "application/json", **(headers or {})},
         )
         resp = conn.getresponse()
         if "text/event-stream" not in (resp.getheader("Content-Type") or ""):
@@ -1322,6 +1345,260 @@ def run_disagg_bench(args) -> dict:
     return artifact
 
 
+# ------------------------------------------------ tenant isolation (ISSUE 18)
+
+
+def _json_post(port: int, body: dict, headers: dict = None,
+               timeout: float = 60.0):
+    """Non-stream POST returning (status, json_doc, response_headers)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/generate", json.dumps(body),
+            {"Content-Type": "application/json", **(headers or {})},
+        )
+        resp = conn.getresponse()
+        return (
+            resp.status,
+            json.loads(resp.read() or b"{}"),
+            dict(resp.getheaders()),
+        )
+    finally:
+        conn.close()
+
+
+def _run_tenant_arm(cfg, params, sampling, cache_len, args, flood, label):
+    """One arm of the tenant-isolation A/B: a real 2-replica QoS fleet
+    (gold slot+page floors, a tight batch token bucket) behind the real
+    router. The gold tenant runs a sequential streaming trickle with
+    client-side clocks; the flood arm adds hostile batch-tenant threads
+    hammering the fleet for the whole trickle window."""
+    from zero_transformer_tpu.serving import (
+        RouterServer,
+        ServingEngine,
+        ServingServer,
+    )
+
+    qos = {
+        "classes": {
+            "gold": {"slot_floor": 1, "page_floor_frac": 0.25},
+            "batch": {"rate": args.tenant_batch_rate,
+                      "burst": args.tenant_batch_burst},
+        }
+    }
+    servers = []
+    for _ in range(2):
+        engine = ServingEngine(
+            cfg, params, n_slots=args.slots, cache_len=cache_len,
+            sampling=sampling, prefill_chunk=args.prefill_chunk,
+            prefix_cache_chunks=0, kv_layout="paged",
+            page_size=args.page_size, qos=qos,
+        )
+        server = ServingServer(engine, _IdTokenizer(), port=0)
+        server.start()
+        servers.append(server)
+    doc = json.loads((REPO / "configs" / "slo_default.json").read_text())
+    doc["qos"]["classes"]["batch"].update(
+        rate=args.tenant_batch_rate, burst=args.tenant_batch_burst
+    )
+    router = RouterServer(
+        [f"127.0.0.1:{s.port}" for s in servers],
+        probe_interval=0.05, max_attempts=2, stream_timeout=600.0, slo=doc,
+    )
+    router.start()
+    try:
+        if not router.wait_ready(60):
+            raise SystemExit(f"TENANT BENCH FAILED: {label} fleet not ready")
+        # warm the compile families outside the measured trickle
+        _sse_timed(
+            router.port, {"tokens": [5, 7], "max_new_tokens": 2},
+            headers={"X-Tenant-Key": "warm", "X-QoS-Class": "gold"},
+        )
+
+        stop = threading.Event()
+        flood_codes: list = []
+        lock = threading.Lock()
+
+        def hostile():
+            while not stop.is_set():
+                try:
+                    code, doc_, hdrs = _json_post(
+                        router.port,
+                        {"tokens": [9, 9, 9],
+                         "max_new_tokens": args.max_new_tokens,
+                         "seed": 0, "stream": False},
+                        headers={"X-Tenant-Key": "flooder",
+                                 "X-QoS-Class": "batch"},
+                    )
+                    with lock:
+                        flood_codes.append((code, doc_, hdrs))
+                except OSError:
+                    pass
+
+        threads = []
+        if flood:
+            threads = [
+                threading.Thread(target=hostile, daemon=True)
+                for _ in range(args.tenant_flood_clients)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+
+        gold_runs = []
+        for i in range(args.tenant_gold_requests):
+            prompt = [3, 5, 7 + i]
+            t0 = time.monotonic()
+            ids, stamps, done = _sse_timed(
+                router.port,
+                {"tokens": prompt, "max_new_tokens": args.max_new_tokens,
+                 "seed": i},
+                headers={"X-Tenant-Key": "vip", "X-QoS-Class": "gold"},
+            )
+            e2e = (time.monotonic() - t0) * 1e3
+            ttft = (stamps[0] - t0) * 1e3 if stamps else float("inf")
+            gold_runs.append((prompt, i, ids, done, e2e, ttft))
+        stop.set()
+        for t in threads:
+            t.join(30)
+
+        rejected = [(c, d, h) for c, d, h in flood_codes if c != 200]
+        bad_rejections = [
+            (c, d) for c, d, h in rejected
+            if c not in (429, 503)
+            or float(h.get("Retry-After", 0)) < 1
+            or not d.get("retryable", True)
+        ]
+        engine_stats = [s.engine.stats for s in servers]
+        arm = {
+            "label": label,
+            "gold_e2e_ms": _pcts([run[4] for run in gold_runs]),
+            "gold_ttft_ms": _pcts([run[5] for run in gold_runs]),
+            "gold_done": sum(
+                1 for run in gold_runs
+                if run[3] is not None and run[3].get("status") == "done"
+            ),
+            "gold_offered": len(gold_runs),
+            "flood_attempts": len(flood_codes),
+            "flood_ok": sum(1 for c, _, _ in flood_codes if c == 200),
+            "flood_rejected": len(rejected),
+            "flood_bad_rejections": len(bad_rejections),
+            "dropped_streams": router.stats["dropped_streams"],
+            "isolation_counters": {
+                "router_rejected_quota": router.stats["rejected_quota"],
+                "engine_rejected_quota": sum(
+                    st["rejected_quota"] for st in engine_stats
+                ),
+                "shed_lower_class": sum(
+                    st["shed_lower_class"] for st in engine_stats
+                ),
+                "preempted_for_class": sum(
+                    st["preempted_for_class"] for st in engine_stats
+                ),
+                "rejected_queue_full": sum(
+                    st["rejected_queue_full"] for st in engine_stats
+                ),
+            },
+        }
+        streams = [
+            (prompt, args.max_new_tokens, seed, ids)
+            for prompt, seed, ids, done, _, _ in gold_runs
+            if done is not None and done.get("status") == "done"
+        ]
+        return arm, streams
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def run_tenant_flood_bench(args) -> dict:
+    """BENCH_tenant.json: the tenant-isolation proof (ISSUE 18). Two arms
+    over the same 2-replica QoS fleet: the gold tenant's trickle alone,
+    then the same trickle under a hostile batch-tenant flood. Correctness
+    is hard-enforced at write time (every gold stream done and token-exact
+    vs ``generate()``, zero dropped streams, every flood rejection
+    retryable with a Retry-After); the headline is the gold e2e-p99 ratio
+    between the arms."""
+    args.greedy = True  # token-exactness is part of the artifact's claim
+    cfg, params, sampling, cache_len, _ = build(args)
+    base, base_streams = _run_tenant_arm(
+        cfg, params, sampling, cache_len, args, flood=False, label="baseline"
+    )
+    flood, flood_streams = _run_tenant_arm(
+        cfg, params, sampling, cache_len, args, flood=True, label="flood"
+    )
+    refs: dict = {}
+
+    def ref(prompt, max_new, seed):
+        key = (tuple(prompt), max_new, seed)
+        if key not in refs:
+            refs[key] = reference_outputs(
+                cfg, params, sampling, cache_len,
+                [(list(prompt), seed)], max_new,
+            )[0]
+        return refs[key]
+
+    token_exact = all(
+        ids == ref(prompt, max_new, seed)
+        for prompt, max_new, seed, ids in base_streams + flood_streams
+    )
+    base_p99 = base["gold_e2e_ms"]["p99"] or 1e-9
+    ratio = round(flood["gold_e2e_ms"]["p99"] / base_p99, 3)
+    artifact = {
+        "bench": "serve_tenant",
+        "metric": "tenant_isolation",
+        "value": ratio,
+        "unit": "gold e2e p99 ratio, flood arm vs baseline (1.0 = isolated)",
+        "isolation_factor_limit": args.tenant_isolation_factor,
+        "config": {
+            "model": args.model, "slots": args.slots,
+            "prefill_chunk": args.prefill_chunk,
+            "page_size": args.page_size,
+            "max_new_tokens": args.max_new_tokens,
+            "gold_requests": args.tenant_gold_requests,
+            "flood_clients": args.tenant_flood_clients,
+            "batch_rate": args.tenant_batch_rate,
+            "batch_burst": args.tenant_batch_burst,
+        },
+        "baseline": base,
+        "flood": flood,
+        "token_exact": token_exact,
+        "dropped_streams": base["dropped_streams"] + flood["dropped_streams"],
+        "platform": _platform_block(),
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    failures = []
+    if (base["gold_done"] != base["gold_offered"]
+            or flood["gold_done"] != flood["gold_offered"]):
+        failures.append("gold streams did not all complete")
+    if not token_exact:
+        failures.append("gold streams not token-exact vs generate()")
+    if artifact["dropped_streams"]:
+        failures.append("dropped streams in a tenant arm")
+    if not flood["flood_rejected"]:
+        failures.append("flood never hit a limit -- not a flood")
+    if flood["flood_bad_rejections"]:
+        failures.append(
+            "flood rejections without retryable semantics (non-429/503 or "
+            "missing Retry-After)"
+        )
+    if sum(flood["isolation_counters"].values()) == 0:
+        failures.append("isolation machinery never engaged")
+    if ratio > args.tenant_isolation_factor:
+        failures.append(
+            f"gold p99 ratio {ratio} exceeds the pinned isolation factor "
+            f"{args.tenant_isolation_factor}"
+        )
+    Path(args.out).write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    if failures:
+        raise SystemExit("TENANT BENCH FAILED: " + "; ".join(failures))
+    return artifact
+
+
 def main(argv=None) -> dict:
     args = parse_args(argv)
     # some images pre-import jax with a platform baked into jax.config,
@@ -1338,7 +1615,7 @@ def main(argv=None) -> dict:
             pass  # backend already initialized (e.g. under pytest)
     if args.workload and (
         args.router or args.long_prompt_flood or args.sawtooth
-        or args.capacity_sweep
+        or args.capacity_sweep or args.tenant_flood
     ):
         raise SystemExit(
             "--workload pins the standard engine-driving workload; the "
@@ -1353,6 +1630,10 @@ def main(argv=None) -> dict:
         if args.out == str(REPO / "BENCH_serve.json"):  # untouched default
             args.out = str(REPO / "BENCH_disagg.json")
         return run_disagg_bench(args)
+    if args.tenant_flood:
+        if args.out == str(REPO / "BENCH_serve.json"):  # untouched default
+            args.out = str(REPO / "BENCH_tenant.json")
+        return run_tenant_flood_bench(args)
     cfg, params, sampling, cache_len, make_engine = build(args)
     if args.capacity_sweep:
         if args.out == str(REPO / "BENCH_serve.json"):  # untouched default

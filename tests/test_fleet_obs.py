@@ -517,8 +517,12 @@ def test_slo_zero_kind_and_config_parsing():
     ))
     assert {o.name for o in objs} == {
         "ttft_p99", "itl_p99", "availability", "dropped_streams",
+        "ttft_p99_gold", "itl_p99_gold",
     }
     assert next(o for o in objs if o.name == "dropped_streams").kind == "zero"
+    # per-class objectives (PR 18) bind to one class's histogram stream
+    assert next(o for o in objs if o.name == "ttft_p99_gold").qos_class == "gold"
+    assert next(o for o in objs if o.name == "ttft_p99").qos_class is None
     with pytest.raises(ValueError, match="unknown keys"):
         parse_slo_config([{"name": "x", "metric": "ttft_p99", "oops": 1}])
     with pytest.raises(ValueError, match="unknown metric"):

@@ -32,9 +32,12 @@ threads stay in ``serving/router.py``.
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("zero_transformer_tpu")
 
 # ------------------------------------------------------------- cost ledger
 
@@ -107,7 +110,7 @@ class TenantLedger:
     LRU-bounded so a tenant-id cardinality attack cannot balloon the
     router."""
 
-    def __init__(self, capacity: int = 1024):
+    def __init__(self, capacity: int = 1024, on_evict=None):
         from collections import OrderedDict
 
         self.capacity = max(1, int(capacity))
@@ -115,14 +118,20 @@ class TenantLedger:
         # evicts idle one-off tenants, never the continuously active one
         self._totals: "OrderedDict[str, Dict[str, float]]" = OrderedDict()
         self._lock = threading.Lock()
+        # eviction is billing-data loss — never silent: count it and let
+        # the owner (the router) turn each drop into a flight event
+        self.on_evict = on_evict
+        self.evictions = 0
 
     def record(self, tenant: str, ledger: Dict[str, Any]) -> None:
         tenant = str(tenant or "anon")[:64]
+        evicted: Optional[str] = None
         with self._lock:
             row = self._totals.get(tenant)
             if row is None:
                 if len(self._totals) >= self.capacity:
-                    self._totals.popitem(last=False)  # least recently used
+                    evicted, _ = self._totals.popitem(last=False)  # idle LRU
+                    self.evictions += 1
                 row = self._totals[tenant] = {k: 0.0 for k in LEDGER_KEYS}
                 row["requests"] = 0.0
             self._totals.move_to_end(tenant)
@@ -132,6 +141,11 @@ class TenantLedger:
                     row[k] += float(ledger.get(k, 0) or 0)
                 except (TypeError, ValueError):
                     pass
+        if evicted is not None and self.on_evict is not None:
+            try:  # outside the lock: the callback may re-enter snapshot()
+                self.on_evict(evicted)
+            except Exception:
+                log.exception("tenant ledger on_evict callback failed")
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:

@@ -65,6 +65,7 @@ class Objective:
     long_window_s: float = 3600.0
     fast_burn: float = 14.4       # burn-rate threshold on BOTH windows
     kind: str = "ratio"           # "ratio" | "zero"
+    qos_class: Optional[str] = None  # bind to one class's metric stream
 
     def __post_init__(self):
         if not (0.0 < self.target < 1.0) and self.kind != "zero":
@@ -81,7 +82,12 @@ class Objective:
 
 def parse_slo_config(spec: Sequence[Dict[str, Any]]) -> List[Objective]:
     """Objectives from a config list (e.g. ``configs/slo_default.json``).
+    Also accepts the PR-18 dict shape ``{"objectives": [...], "qos": ...,
+    "brownout": ...}`` — the qos/brownout blocks belong to their owners
+    (``QosPolicy.from_config`` / the router) and are ignored here.
     Unknown keys are an error — a typo must not silently weaken an SLO."""
+    if isinstance(spec, dict):
+        spec = spec.get("objectives") or []
     out: List[Objective] = []
     allowed = {f.name for f in dataclasses.fields(Objective)}
     for raw in spec:
@@ -289,6 +295,7 @@ class SLOEngine:
         return {
             "metric": obj.metric,
             "kind": obj.kind,
+            "qos_class": obj.qos_class,
             "target": obj.target,
             "threshold_s": obj.threshold_s,
             "state": st.state,

@@ -31,6 +31,17 @@ from zero_transformer_tpu.serving.prefix_cache import (
     PagedPrefixIndex,
     PrefixCache,
 )
+from zero_transformer_tpu.serving.qos import (
+    BROWNOUT_RUNGS,
+    QOS_CLASSES,
+    BrownoutController,
+    ClassQueue,
+    QosClassConfig,
+    QosPolicy,
+    TenantBuckets,
+    TokenBucket,
+    rung_at_least,
+)
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
     DRAINING,
@@ -65,9 +76,18 @@ from zero_transformer_tpu.serving.slots import (
 )
 
 __all__ = [
+    "BROWNOUT_RUNGS",
+    "BrownoutController",
+    "ClassQueue",
     "DEGRADED",
     "DRAINING",
     "EJECTED",
+    "QOS_CLASSES",
+    "QosClassConfig",
+    "QosPolicy",
+    "TenantBuckets",
+    "TokenBucket",
+    "rung_at_least",
     "READY",
     "STARTING",
     "STOPPED",

@@ -128,6 +128,14 @@ from zero_transformer_tpu.inference.sampling import (
 from zero_transformer_tpu.inference.speculative import ngram_propose
 from zero_transformer_tpu.resilience.detect import nonfinite_rows
 from zero_transformer_tpu.serving.prefix_cache import PagedPrefixIndex, PrefixCache
+from zero_transformer_tpu.serving.qos import (
+    BROWNOUT_RUNGS,
+    ClassQueue,
+    QosPolicy,
+    TenantBuckets,
+    reserved_above,
+    rung_at_least,
+)
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
     DRAINING,
@@ -190,6 +198,11 @@ class Request:
     # replica URL instead of decoding here (required on prefill-role
     # engines; honored on mixed engines too)
     prefill_to: Optional[str] = None
+    # overload isolation (PR 18): the billing identity and QoS class this
+    # request admits under. Unknown classes normalize to the policy's
+    # default at submit; "anon"/default is the full pre-QoS behavior.
+    tenant: str = "anon"
+    qos: str = "standard"
 
 
 class RequestHandle:
@@ -261,6 +274,18 @@ class RequestHandle:
         self._events: queue_mod.Queue = queue_mod.Queue()
         self._done = threading.Event()
         self._cancel = threading.Event()
+        # bounded emit buffer (slow-client protection): once a STREAMING
+        # consumer has attached (the server's SSE pump sets
+        # consumer_attached) and stops draining, token events past
+        # emit_buffer_max are dropped and ``overflowed`` trips — the
+        # scheduler then finishes the stream retryably instead of holding
+        # its slot/pages for a reader that went away. Non-streaming
+        # waiters (result()) never attach, so their buffering stays
+        # bounded by max_new_tokens exactly as before. The terminal
+        # ("done", status) event is NEVER dropped.
+        self.emit_buffer_max: int = 1024
+        self.consumer_attached = False
+        self.overflowed = False
 
     # -- consumer side -----------------------------------------------------
 
@@ -340,6 +365,14 @@ class RequestHandle:
         if self.first_token_at is None:
             self.first_token_at = now
         self.tokens.append(token)
+        if (
+            self.consumer_attached
+            and self._events.qsize() >= self.emit_buffer_max
+        ):
+            # stalled streaming reader: stop buffering (the scheduler
+            # notices ``overflowed`` this tick and finishes retryably)
+            self.overflowed = True
+            return
         self._events.put(("token", token))
 
     def _finish(
@@ -845,6 +878,9 @@ class ServingEngine:
         trace: bool = True,
         trace_capacity: int = 8192,
         flight_capacity: int = 256,
+        qos=None,
+        emit_buffer_max: int = 1024,
+        tenant_buckets_capacity: int = 4096,
     ):
         self.cfg = cfg
         self.cache_len = cache_len or cfg.max_seq_len
@@ -1017,7 +1053,22 @@ class ServingEngine:
         self._migrating: Dict[int, RequestHandle] = {}  # awaiting ship ack
         self._migrations_in_flight = 0
 
-        self._queue: deque = deque()
+        # overload isolation (PR 18): the declared class policy (inert
+        # defaults when no config — no floors, unlimited buckets), the
+        # per-(tenant, class) admission buckets, and the admission queue
+        # as per-class deficit-weighted round-robin priced in work tokens
+        self.qos = (
+            qos if isinstance(qos, QosPolicy) else QosPolicy.from_config(qos)
+        )
+        self._tenant_buckets = TenantBuckets(
+            self.qos, capacity=tenant_buckets_capacity
+        )
+        self.emit_buffer_max = max(1, int(emit_buffer_max))
+        self._queue: ClassQueue = self._make_queue()
+        # brownout rung in force on THIS replica (the router's fleet
+        # controller pushes transitions via POST /admin/brownout; an
+        # engine-local set_brownout serves single-replica deployments)
+        self._brownout_rung = BROWNOUT_RUNGS[0]
         self.max_queue = max_queue
         self._lock = threading.Lock()
         self._ids = itertools.count()
@@ -1097,6 +1148,18 @@ class ServingEngine:
             "migrations_in": 0,
             "migration_failures": 0,
             "prefill_handoffs": 0,
+            # overload-isolation counters (PR 18): per-tenant bucket
+            # rejections, queue-full sheds that evicted a LOWER class to
+            # keep a higher one, preemptions of running lower-class
+            # streams for a waiting higher class, brownout admission
+            # rejections + rung transitions, and streams finished because
+            # their SSE consumer stalled past the emit-buffer bound
+            "rejected_quota": 0,
+            "rejected_brownout": 0,
+            "shed_lower_class": 0,
+            "preempted_for_class": 0,
+            "brownout_transitions": 0,
+            "stalled_streams": 0,
             # pinned 0 BY CONSTRUCTION: an imported stream installs its
             # shipped pages and never runs prefill for consumed positions
             # (asserted via dest prefill_chunks == 0 in the parity tests).
@@ -1144,6 +1207,25 @@ class ServingEngine:
             "Admission-to-install prefill latency (prefix hits included)",
             LATENCY_BUCKETS,
         )
+        # per-class latency families: the fleet aggregator merges these by
+        # name, so per-class SLO objectives (qos_class on an Objective)
+        # bind to `serve_ttft_seconds_<class>` with zero aggregator work
+        self._h_ttft_class = {
+            name: self.registry.histogram(
+                f"serve_ttft_seconds_{name}",
+                f"Submit-to-first-token latency, {name} class",
+                LATENCY_BUCKETS,
+            )
+            for name in self.qos.names()
+        }
+        self._h_itl_class = {
+            name: self.registry.histogram(
+                f"serve_itl_seconds_{name}",
+                f"Inter-token latency, {name} class",
+                LATENCY_BUCKETS,
+            )
+            for name in self.qos.names()
+        }
         # legacy attribute names: tests and older callers measured the
         # latency deques by len(); Histogram.__len__ keeps that contract
         self._ttft = self._h_ttft
@@ -1160,6 +1242,148 @@ class ServingEngine:
         if self.kv_layout == "paged":
             return PagedKVCache(self.model, self.n_slots, mesh=self.mesh)
         return SlotKVCache(self.model, self.n_slots, mesh=self.mesh)
+
+    def _make_queue(self) -> ClassQueue:
+        """The admission queue: per-class DWRR priced in work tokens (the
+        same unit reservations use), classed by each request's qos."""
+        return ClassQueue(
+            self.qos,
+            cost=lambda h: self._total_need_tokens(h.request),
+            class_of=lambda h: h.request.qos,
+        )
+
+    # -------------------------------------------------------- qos / brownout
+
+    def _class_slots_in_use(self) -> Dict[str, int]:
+        """Decode + mid-prefill slots currently held, per class."""
+        counts = {name: 0 for name in self.qos.names()}
+        for act in self._active:
+            if act is not None:
+                counts[self.qos.normalize(act.handle.request.qos)] += 1
+        for job in self._prefilling.values():
+            counts[self.qos.normalize(job.handle.request.qos)] += 1
+        return counts
+
+    def _class_pages_in_use(self) -> Dict[str, int]:
+        """KV pages RESERVED per class (the admission-time worst case —
+        derivable from the handles alone, so no stateful page accounting
+        can drift)."""
+        counts = {name: 0 for name in self.qos.names()}
+        for act in self._active:
+            if act is not None:
+                counts[self.qos.normalize(act.handle.request.qos)] += (
+                    self.slots.blocks_for(
+                        self._total_need_tokens(act.handle.request)
+                    )
+                )
+        for job in self._prefilling.values():
+            counts[self.qos.normalize(job.handle.request.qos)] += (
+                self.slots.blocks_for(
+                    self._total_need_tokens(job.handle.request)
+                )
+            )
+        return counts
+
+    def _slot_eligible(self, cls: str, in_use: Dict[str, int]) -> bool:
+        """May class ``cls`` take a free slot now? Only if doing so leaves
+        at least the unmet slot floors of every higher class free."""
+        floors = {
+            name: float(c.slot_floor) for name, c in self.qos.classes.items()
+        }
+        held = reserved_above(
+            self.qos, cls, floors, {k: float(v) for k, v in in_use.items()}
+        )
+        return self.slots.free_count > held
+
+    def _pages_reserved_above(self, cls: str) -> int:
+        """Paged-pool pages held back from class ``cls`` by higher-class
+        floors (page_floor_frac x total pool, minus what those classes
+        already hold)."""
+        total = self.slots.pool.n_pages - 1
+        floors = {
+            name: float(int(c.page_floor_frac * total))
+            for name, c in self.qos.classes.items()
+        }
+        if not any(floors.values()):
+            return 0
+        in_use = {
+            k: float(v) for k, v in self._class_pages_in_use().items()
+        }
+        return int(reserved_above(self.qos, cls, floors, in_use))
+
+    @property
+    def brownout_rung(self) -> str:
+        return self._brownout_rung
+
+    def set_brownout(self, rung: str) -> Dict[str, Any]:
+        """Apply a brownout rung (router push or operator override).
+        Idempotent; every transition is a flight-recorder event and a
+        counter. Rung effects at admission/dispatch time:
+        ``no_spec`` disables speculative decode; ``shrink_batch``
+        additionally clamps batch-class token budgets; ``suspend_batch``
+        additionally rejects batch admission (retryable, class
+        Retry-After)."""
+        if rung not in BROWNOUT_RUNGS:
+            raise ValueError(
+                f"unknown brownout rung {rung!r} (rungs: {BROWNOUT_RUNGS})"
+            )
+        old = self._brownout_rung
+        if rung != old:
+            self._brownout_rung = rung
+            self.stats["brownout_transitions"] += 1
+            self._event("brownout_rung", old=old, new=rung)
+        return {"rung": self._brownout_rung, "previous": old}
+
+    @property
+    def _spec_enabled(self) -> bool:
+        return not rung_at_least(self._brownout_rung, "no_spec")
+
+    def _maybe_preempt_for_class(self) -> None:
+        """With zero free slots and a gold request waiting, preempt ONE
+        running stream of the lowest active class (strictly lower-ranked
+        than the waiter) — retryable finish, so the router re-dispatches
+        it; the freed slot admits the gold request this same tick. The
+        least-progressed victim loses the least work. Never fires across
+        equal ranks, so batch-vs-batch contention stays FIFO."""
+        if self.slots.free_count:
+            return
+        with self._lock:
+            waiting = self._queue.best_waiting_rank()
+        if waiting is None or waiting != 0:  # only the TOP class preempts
+            return
+        victim_slot, victim_rank, victim_emitted = None, -1, -1
+        for slot, act in enumerate(self._active):
+            if act is None:
+                continue
+            rank = self.qos.rank(act.handle.request.qos)
+            if rank <= waiting:
+                continue
+            # lowest class first; among equals, least progress lost
+            if rank > victim_rank or (
+                rank == victim_rank and act.emitted < victim_emitted
+            ):
+                victim_slot, victim_rank, victim_emitted = (
+                    slot, rank, act.emitted
+                )
+        if victim_slot is None:
+            return
+        now = self.now()
+        victim = self._active[victim_slot]
+        cls = self.qos.class_of(victim.handle.request.qos)
+        victim.handle._finish(
+            FAILED, now,
+            error=(
+                f"preempted for higher QoS class (retryable): "
+                f"{cls.name} stream yielded its slot"
+            ),
+            retryable=True, retry_after=cls.retry_after_s,
+        )
+        self.stats["preempted_for_class"] += 1
+        self._retire([victim_slot])
+        self._event(
+            "qos_preemption", victim_class=cls.name,
+            emitted=victim_emitted,
+        )
 
     def _make_prefix_cache(self) -> Optional[PrefixCache]:
         if not (self.prefill_chunk and self._prefix_cache_chunks):
@@ -1245,6 +1469,8 @@ class ServingEngine:
         request_id: Optional[str] = None,
         prefill_to: Optional[str] = None,
         trace_hop: Optional[int] = None,
+        tenant: str = "anon",
+        qos: Optional[str] = None,
     ) -> RequestHandle:
         """Enqueue a request; returns its handle immediately.
 
@@ -1256,17 +1482,33 @@ class ServingEngine:
         is generated here at admission. ``trace_hop`` is the router's hop
         index for this dispatch (``X-Trace-Hop``) — recorded on the span
         tree so the stitched fleet trace can order hops across processes.
+        ``tenant``/``qos`` (``X-Tenant-Key`` / ``X-QoS-Class``) select the
+        token bucket the request is charged to and the class it queues,
+        sheds, and browns out as.
         """
         now = self.now()
         if timeout is not None:
             deadline = now + timeout if deadline is None else min(deadline, now + timeout)
+        qos_name = self.qos.normalize(qos)
+        cls = self.qos.classes[qos_name]
+        max_new_tokens = int(max_new_tokens)
+        if (
+            rung_at_least(self._brownout_rung, "shrink_batch")
+            and cls.brownout_max_new_tokens is not None
+            and max_new_tokens > cls.brownout_max_new_tokens
+        ):
+            # brownout rung 2: the class keeps serving, on a shrunken
+            # budget — graceful degradation before any admission is cut
+            max_new_tokens = cls.brownout_max_new_tokens
         request = Request(
-            list(prompt), int(max_new_tokens), int(seed), deadline,
+            list(prompt), max_new_tokens, int(seed), deadline,
             prefill_to=prefill_to,
+            tenant=str(tenant or "anon")[:64], qos=qos_name,
         )
         handle = RequestHandle(request, next(self._ids), now, request_id=request_id)
         handle._tracer = self.tracer
         handle.trace_hop = trace_hop
+        handle.emit_buffer_max = self.emit_buffer_max
         invalid = self._validate(request)
         with self._lock:
             if self._dead is not None:
@@ -1295,14 +1537,65 @@ class ServingEngine:
                 self.stats["rejected_invalid"] += 1
                 handle._finish(REJECTED, now, error=invalid)
                 return handle
-            if len(self._queue) >= self.max_queue:
-                self.stats["rejected_queue_full"] += 1
+            if (
+                rung_at_least(self._brownout_rung, "suspend_batch")
+                and self.qos.rank(qos_name) == len(self.qos.names()) - 1
+            ):
+                # brownout rung 3: the lowest class stops admitting
+                # entirely — its budget already shrank at rung 2; now its
+                # traffic waits out the overload elsewhere
+                self.stats["rejected_brownout"] += 1
                 handle._finish(
                     REJECTED, now,
-                    error=f"queue full ({self.max_queue} waiting); retry later",
-                    retryable=True, retry_after=1.0,
+                    error=(
+                        f"brownout ({self._brownout_rung}): "
+                        f"{qos_name} admission suspended; retry later"
+                    ),
+                    retryable=True, retry_after=cls.retry_after_s,
                 )
                 return handle
+            quota_wait = self._tenant_buckets.take(
+                request.tenant, qos_name,
+                len(request.prompt) + request.max_new_tokens, now,
+            )
+            if quota_wait > 0:
+                # the tenant's own bucket is dry — its flood is ITS
+                # problem; every other tenant's admission is untouched
+                self.stats["rejected_quota"] += 1
+                handle._finish(
+                    REJECTED, now,
+                    error=(
+                        f"tenant quota exhausted ({qos_name}); "
+                        f"retry later"
+                    ),
+                    retryable=True, retry_after=quota_wait,
+                )
+                return handle
+            if len(self._queue) >= self.max_queue:
+                # queue-full pressure evicts the newest STRICTLY-lower
+                # class request (retryably) before rejecting a higher one
+                victim = self._queue.pop_lowest_class(
+                    above_rank=self.qos.rank(qos_name)
+                )
+                if victim is not None:
+                    vcls = self.qos.class_of(victim.request.qos)
+                    self.stats["shed_lower_class"] += 1
+                    victim._finish(
+                        REJECTED, now,
+                        error=(
+                            f"queue full; shed for higher QoS class "
+                            f"({vcls.name} yielded); retry later"
+                        ),
+                        retryable=True, retry_after=vcls.retry_after_s,
+                    )
+                else:
+                    self.stats["rejected_queue_full"] += 1
+                    handle._finish(
+                        REJECTED, now,
+                        error=f"queue full ({self.max_queue} waiting); retry later",
+                        retryable=True, retry_after=max(1.0, cls.retry_after_s),
+                    )
+                    return handle
             if request.deadline is not None and infeasible_deadline(
                 request.deadline, now, request.max_new_tokens,
                 len(self._queue), self.n_slots, self._itl_ewma,
@@ -1405,13 +1698,19 @@ class ServingEngine:
 
     # -------------------------------------------------------------- schedule
 
-    def _pop_queue(self) -> Optional[RequestHandle]:
-        """Pop the next admissible queued handle, finishing cancelled /
-        expired ones on the way; None when nothing is admissible."""
+    def _pop_queue(
+        self, eligible=None,
+    ) -> Optional[RequestHandle]:
+        """Pop the next admissible queued handle (DWRR-fair across QoS
+        classes; ``eligible`` gates classes whose admission would eat a
+        higher class's reservation floor), finishing cancelled / expired
+        ones on the way; None when nothing is admissible."""
         with self._lock:
             now = self.now()
             while self._queue:
-                cand = self._queue.popleft()
+                cand = self._queue.popleft(eligible=eligible)
+                if cand is None:
+                    return None
                 if cand._cancel.is_set():
                     self.stats["cancelled"] += 1
                     cand._finish(CANCELLED, now)
@@ -1446,8 +1745,12 @@ class ServingEngine:
         request WAITS at the queue head instead. That waiting is the
         capacity signal the loadgen sweep measures."""
         paged = self.kv_layout == "paged"
+        self._maybe_preempt_for_class()
         while self.slots.free_count:
-            handle = self._pop_queue()
+            in_use = self._class_slots_in_use()
+            handle = self._pop_queue(
+                eligible=lambda c: self._slot_eligible(c, in_use)
+            )
             if handle is None:
                 return
             if paged and not self._paged_admission_fits(handle):
@@ -1493,12 +1796,18 @@ class ServingEngine:
         reclaims cold prefix-cache pages (a PAGE FAULT — counted), then
         gives up and lets the request wait."""
         need_total = self.slots.blocks_for(self._total_need_tokens(handle.request))
+        # page reservation floors: pages held back for higher classes are
+        # invisible to THIS class's admission (batch can never consume the
+        # pool headroom gold admission needs)
+        held_above = self._pages_reserved_above(handle.request.qos)
         for attempt in (0, 1):
             hit_blocks = 0
             if self._prefix_cache is not None:
                 fill, _ = self._prefix_cache.walk(handle.request.prompt)
                 hit_blocks = fill // self.page_size
-            shortfall = (need_total - hit_blocks) - self.slots.pool.available
+            shortfall = (need_total - hit_blocks) - (
+                self.slots.pool.available - held_above
+            )
             if shortfall <= 0:
                 return True
             if attempt or self._prefix_cache is None or not len(self._prefix_cache):
@@ -1516,8 +1825,12 @@ class ServingEngine:
         admitted this pass coalesced into one ``_install_rows`` call."""
         installs: List[tuple] = []
         try:
+            self._maybe_preempt_for_class()
             while self.slots.free_count:
-                handle = self._pop_queue()
+                in_use = self._class_slots_in_use()
+                handle = self._pop_queue(
+                    eligible=lambda c: self._slot_eligible(c, in_use)
+                )
                 if handle is None:
                     return
                 handle.admitted_at = self.now()
@@ -1957,17 +2270,21 @@ class ServingEngine:
         ``cancel()``'s next-tick promise) must not wait for a slot to free."""
         now = self.now()
         with self._lock:
-            kept: deque = deque()
+            kept: List[RequestHandle] = []
+            dropped = False
             for cand in self._queue:
                 if cand._cancel.is_set():
                     self.stats["cancelled"] += 1
                     cand._finish(CANCELLED, now)
+                    dropped = True
                 elif cand.request.deadline is not None and now > cand.request.deadline:
                     self.stats["expired_queued"] += 1
                     cand._finish(EXPIRED, now, error="deadline expired in queue")
+                    dropped = True
                 else:
                     kept.append(cand)
-            self._queue = kept
+            if dropped:
+                self._queue.rebuild(kept)
 
     # graftlint: hot-path
     # graftlint: supervised-seam
@@ -2025,7 +2342,7 @@ class ServingEngine:
                 # (admissions, growth, retirements) before the fused step
                 # reads the device tables
                 self.slots.flush_tables()
-            if self.draft_k:
+            if self.draft_k and self._spec_enabled:
                 blocks, n_emits, bad_rows = self._dispatch_spec()
             else:
                 if self.fused_tail:
@@ -2097,13 +2414,14 @@ class ServingEngine:
         now = self.now()
         finished: List[int] = []
         poisoned: List[int] = []
-        ttft_new: List[float] = []
-        itl_new: List[float] = []
+        ttft_new: List[tuple] = []  # (sample_s, qos_class)
+        itl_new: List[tuple] = []
         tokens_before = self.stats["tokens_out"]
         paged_ledger = self.kv_layout == "paged"
         for slot, act in enumerate(self._active):
             if act is None:
                 continue
+            qos_cls = self.qos.normalize(act.handle.request.qos)
             toks = blocks[slot][: n_emits[slot]]
             # cost ledger: one decode tick held, at this slot's current KV
             # page footprint (pages x ticks is the capacity-time integral a
@@ -2114,14 +2432,14 @@ class ServingEngine:
                     self.slots.alloc_blocks[slot]
                 )
             if act.emitted == 0:
-                ttft_new.append(now - act.handle.submitted_at)
+                ttft_new.append((now - act.handle.submitted_at, qos_cls))
             elif act.last_emit_at is not None:
                 # a speculative tick delivers its accepted block in one
                 # burst; one AMORTIZED sample per token keeps the ITL
                 # percentiles honest about per-token latency (n_emit = 1
                 # degenerates to the classic one-sample-per-tick)
                 gap = now - act.last_emit_at
-                itl_new.extend([gap / len(toks)] * len(toks))
+                itl_new.extend([(gap / len(toks), qos_cls)] * len(toks))
             # the block's first token was sampled from the PREVIOUS (finite)
             # logits, so it is valid even when the new logits went bad —
             # emit it, then retire the poisoned slot with a retryable error
@@ -2155,6 +2473,22 @@ class ServingEngine:
                 self.stats["poisoned_slots"] += 1
                 poisoned.append(slot)
                 finished.append(slot)
+            elif not done_now and act.handle.overflowed:
+                # the STREAMING consumer stopped draining past the emit
+                # buffer bound: stop paying slot/page capacity for a
+                # reader that went away. Retryable — the done event always
+                # delivers, so a recovered client re-submits cleanly.
+                act.handle._finish(
+                    FAILED, now,
+                    error=(
+                        "client stalled mid-stream; emit buffer "
+                        "overflowed (retryable)"
+                    ),
+                    retryable=True,
+                )
+                self.stats["stalled_streams"] += 1
+                finished.append(slot)
+                self._event("stalled_stream", request_id=act.handle.rid)
         if any(bad_rows):
             # zero EVERY bad row (poisoned-and-retired or finished-anyway)
             # so a parked slot never feeds NaN back into the next tick's
@@ -2165,10 +2499,12 @@ class ServingEngine:
             self._event("poisoned_slots", slots=len(poisoned))
         # histograms carry their own micro-locks — no scheduler lock, and a
         # concurrent /metrics scrape reads bucket counts, never a sample list
-        for sample in ttft_new:
+        for sample, cls in ttft_new:
             self._h_ttft.observe(sample)
-        for sample in itl_new:
+            self._h_ttft_class[cls].observe(sample)
+        for sample, cls in itl_new:
             self._h_itl.observe(sample)
+            self._h_itl_class[cls].observe(sample)
             if not self._prefill_work:
                 # per-phase attribution: this tick ran no prefill work
                 # (chunk, span copy, or one-shot admission), so these
@@ -2969,7 +3305,8 @@ class ServingEngine:
             self._drain_deadline = (
                 now + deadline_s if deadline_s is not None else None
             )
-            queued, self._queue = list(self._queue), deque()
+            queued = list(self._queue)
+            self._queue.clear()
             pending, self._pending_imports = (
                 list(self._pending_imports), deque()
             )
@@ -3179,7 +3516,8 @@ class ServingEngine:
         self.lifecycle.to(STOPPED, reason=reason)
         with self._lock:
             self._dead = reason
-            queued, self._queue = list(self._queue), deque()
+            queued = list(self._queue)
+            self._queue.clear()
         for handle in queued:
             handle._finish(FAILED, now, error=reason)
         for slot, act in enumerate(self._active):
@@ -3300,8 +3638,12 @@ class ServingEngine:
             "spec_ticks", "draft_tokens", "accepted_tokens",
             "migrations_out", "migrations_in", "migration_failures",
             "prefill_handoffs", "import_replayed_tokens",
+            "rejected_quota", "rejected_brownout", "shed_lower_class",
+            "preempted_for_class", "brownout_transitions", "stalled_streams",
         ):
             snap[k] = self.stats[k]
+        snap["brownout_rung"] = self._brownout_rung
+        snap["queue_by_class"] = self._queue.counts()
         return snap
 
     def prometheus_text(self) -> str:
@@ -3348,6 +3690,16 @@ class ServingEngine:
             ("prefill_handoffs", "Disaggregated prefill-to-decode handoffs"),
             ("import_replayed_tokens",
              "Tokens recomputed by imported streams (0 by construction)"),
+            ("rejected_quota", "Admission rejections: tenant quota exhausted"),
+            ("rejected_brownout",
+             "Admission rejections: brownout suspended the class"),
+            ("shed_lower_class",
+             "Queue-full sheds that evicted a lower QoS class"),
+            ("preempted_for_class",
+             "Running streams preempted for a waiting higher class"),
+            ("brownout_transitions", "Brownout rung transitions"),
+            ("stalled_streams",
+             "Streams retired because the client stalled (emit overflow)"),
         ):
             reg.counter_func(
                 f"serve_{key}", help_text,
@@ -3356,6 +3708,11 @@ class ServingEngine:
         reg.gauge_func(
             "serve_queue_depth", "Requests waiting for a slot",
             lambda: len(self._queue),
+        )
+        reg.gauge_func(
+            "serve_brownout_rung",
+            "Brownout rung index (0=normal .. 3=suspend_batch)",
+            lambda: BROWNOUT_RUNGS.index(self._brownout_rung),
         )
         reg.gauge_func(
             "serve_slot_occupancy", "Slots actively decoding",

@@ -249,13 +249,18 @@ class ServeFault(Fault):
     """A serving fault (extends the training ``Fault``).
 
     kind: "tick_fault" | "prefill_fault" | "nan_logits" | "slow_tick" |
-          "sigterm" | "corrupt_reload"
+          "sigterm" | "corrupt_reload" | "slow_client"
     step: the scheduler TICK index the fault keys on (engine ``_tick``,
       0-based) — sigterm/slow_tick fire once at the first tick >= step;
       tick_fault / prefill_fault / nan_logits fire for ``duration``
       consecutive ticks. A prefill_fault raises inside the CHUNK-prefill
       dispatch (before the fused decode), proving the engine fails only
       the mid-prefill slots and leaves decoding neighbors untouched.
+      "slow_client" is a CONSUMER fault: the server's SSE pump stalls for
+      ``duration`` seconds mid-stream (a reader that stopped draining its
+      socket), proving the bounded emit buffer finishes the stalled
+      stream retryably while neighbors stay byte-identical; ``step`` here
+      is the number of events the pump delivers before stalling.
     slots: for "nan_logits", which cache rows to poison (None = every
       occupied row) — how the harness proves the guard retires ONLY the
       affected slots.
@@ -293,6 +298,18 @@ class ServingChaosMonkey(ChaosMonkey):
                 if not f.fired:
                     self.record(f)
                 raise f.exc(f"{f.message} (decode tick {tick})")
+
+    def client_stall_s(self, events_delivered: int) -> float:
+        """SSE-pump seam ("slow_client"): called by the server's stream
+        pump after each delivered event; returns the seconds the pump
+        should stall (simulating a reader that stopped draining) once
+        ``events_delivered`` reaches the fault's ``step``. One-shot."""
+        stall = 0.0
+        for f in self._of_kind("slow_client"):
+            if not f.fired and events_delivered >= f.step:
+                self.record(f)
+                stall += float(f.duration)
+        return stall
 
     def on_prefill_chunk(self, tick: int) -> None:
         """Called at the top of a supervised chunk-prefill dispatch: a

@@ -87,6 +87,12 @@ from zero_transformer_tpu.obs.slo import (
     parse_slo_config,
 )
 from zero_transformer_tpu.obs.spans import Tracer
+from zero_transformer_tpu.serving.qos import (
+    BrownoutController,
+    QosPolicy,
+    TenantBuckets,
+    rung_at_least,
+)
 from zero_transformer_tpu.serving.resilience import (
     DEGRADED,
     DRAINING,
@@ -736,6 +742,16 @@ class RouterServer:
             "slo_evaluations": 0,
             "slo_fast_burns": 0,
             "stitched_traces": 0,
+            # overload isolation plane (PR 18): fleet-level quota and
+            # brownout rejections at the front door, controller rung
+            # transitions, tenant-affinity routing, and ledger-eviction
+            # honesty (a silently dropped tenant row would under-bill)
+            "rejected_quota": 0,
+            "rejected_brownout": 0,
+            "brownout_transitions": 0,
+            "tenant_affinity_hits": 0,
+            "tenant_affinity_misses": 0,
+            "tenant_ledger_evictions": 0,
         }
         # handler threads bump stats concurrently; += on a dict entry is a
         # read-modify-write, so every increment goes through _bump
@@ -752,8 +768,36 @@ class RouterServer:
         # objectives over the aggregated streams on the obs loop
         self.metrics_scrape_interval = float(metrics_scrape_interval)
         self.aggregator = FleetAggregator()
-        self.tenants = TenantLedger(capacity=tenant_ledger_capacity)
+        self.tenants = TenantLedger(
+            capacity=tenant_ledger_capacity,
+            on_evict=self._on_tenant_evicted,
+        )
         self.slo_eval_interval = float(slo_eval_interval)
+        # overload isolation plane (PR 18): the QoS policy + brownout
+        # config ride in the same dict as the SLO objectives (one file,
+        # ``configs/slo_default.json``) — a plain objective list still
+        # works and leaves the inert default policy in place
+        qos_spec = slo.get("qos") if isinstance(slo, dict) else None
+        brownout_spec = (
+            slo.get("brownout") if isinstance(slo, dict) else None
+        ) or {}
+        self.qos = QosPolicy.from_config(qos_spec)
+        # fleet-level tenant quotas: one bucket set at the front door,
+        # scaled by the routable-replica count at take() time so fleet
+        # allotment tracks fleet capacity
+        self._fleet_buckets = TenantBuckets(self.qos)
+        self.brownout = BrownoutController(
+            calm_evals=int(brownout_spec.get("calm_evals", 3)),
+        )
+        protected = brownout_spec.get("protected_classes")
+        self._brownout_protected: Tuple[str, ...] = tuple(
+            protected if protected else ("gold", "standard")
+        )
+        # tenant -> replica-id routing affinity (LRU, same bound as the
+        # prefix map); prefix affinity is more specific and wins
+        self._tenant_affinity: OrderedDict = OrderedDict()
+        self._tenant_affinity_capacity = max(1, int(affinity_capacity))
+        self._tenant_aff_lock = threading.Lock()
         self.slo = self._build_slo(slo)
         self._slo_hot = False  # fast-burn up-signal the autoscaler consumes
         self._slo_lock = threading.Lock()
@@ -826,7 +870,9 @@ class RouterServer:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802
-                if self.path not in ("/generate", "/admin/reload"):
+                if self.path not in (
+                    "/generate", "/admin/reload", "/admin/brownout",
+                ):
                     self._json(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -851,12 +897,15 @@ class RouterServer:
                 if not isinstance(req, dict):
                     self._json(400, {"error": "body must be a JSON object"})
                     return
-                if self.path == "/admin/reload":
+                if self.path.startswith("/admin/"):
                     if not outer._admin_allowed(self):
                         self._json(403, {"error": "admin endpoint: loopback "
                                                   "or bearer token required"})
                         return
-                    self._json(*outer._admin_reload(req))
+                    if self.path == "/admin/brownout":
+                        self._json(*outer._admin_brownout(req))
+                    else:
+                        self._json(*outer._admin_reload(req))
                 else:
                     outer._generate(self, req)
 
@@ -958,6 +1007,12 @@ class RouterServer:
             if name == "ejected":
                 self._bump("ejections")
                 self.affinity.forget_replica(rid)
+                with self._tenant_aff_lock:
+                    for t in [
+                        t for t, r in self._tenant_affinity.items()
+                        if r == rid
+                    ]:
+                        del self._tenant_affinity[t]
                 self.flight.event("replica_ejected", replica=rid)
                 # the post-mortem window: what the fleet looked like when
                 # the replica dropped out (probe history, relay counters)
@@ -987,7 +1042,7 @@ class RouterServer:
                     now - last_eval >= self.slo_eval_interval
                 ):
                     last_eval = now
-                    self.evaluate_slo()
+                    self.brownout_tick(self.evaluate_slo())
             except Exception:  # noqa: BLE001 — the obs loop must outlive any one bad scrape
                 self.flight.event("obs_loop_error")
 
@@ -1030,6 +1085,12 @@ class RouterServer:
         sequence disables SLO evaluation."""
         if spec is None:
             objectives = default_objectives()
+        elif isinstance(spec, dict):
+            # config-file shape: {"qos": ..., "brownout": ..., "objectives":
+            # [...]} — the qos/brownout blocks were consumed in __init__
+            objectives = parse_slo_config(spec)
+            if not objectives:
+                return None
         elif not spec:
             return None
         elif all(isinstance(o, Objective) for o in spec):
@@ -1046,13 +1107,18 @@ class RouterServer:
         """(bad, total) cumulative source for one declared metric: latency
         objectives read the fleet-merged histograms (aggregated streams),
         availability and dropped_streams read the router's own counters."""
+        # a qos_class binds the objective to that class's OWN histogram
+        # stream (``serve_ttft_seconds_gold``) — the engine emits one
+        # family per declared class, and the aggregator merges any family
+        # name, so a per-class objective needs no aggregator changes
+        suffix = f"_{obj.qos_class}" if obj.qos_class else ""
         if obj.metric == "ttft_p99":
             return lambda: self._latency_source(
-                "serve_ttft_seconds", obj.threshold_s
+                f"serve_ttft_seconds{suffix}", obj.threshold_s
             )
         if obj.metric == "itl_p99":
             return lambda: self._latency_source(
-                "serve_itl_seconds", obj.threshold_s
+                f"serve_itl_seconds{suffix}", obj.threshold_s
             )
         if obj.metric == "availability":
             def availability():
@@ -1118,6 +1184,86 @@ class RouterServer:
         with self._slo_lock:
             hot, self._slo_hot = self._slo_hot, False
         return hot
+
+    # ------------------------------------------------ fleet brownout control
+
+    def _brownout_hot(self, evaluation: Dict[str, Any]) -> bool:
+        """One evaluation's verdict for the brownout ladder: a PROTECTED
+        class's own objective is burning fast or violated. Fleet-wide
+        (classless) objectives feed the autoscaler, not the ladder — the
+        ladder exists to sacrifice batch for gold, and only a per-class
+        signal says WHO is hurting."""
+        for snap in (evaluation.get("objectives") or {}).values():
+            if (
+                snap.get("qos_class") in self._brownout_protected
+                and snap.get("state") in ("fast_burn", "violated")
+            ):
+                return True
+        return False
+
+    def brownout_tick(self, evaluation: Dict[str, Any]) -> None:
+        """One controller step, driven by the obs loop right after each
+        SLO evaluation (tests call it directly with a synthetic payload).
+        Escalations and reverts both propagate to every routable replica;
+        a non-normal rung is also re-asserted each tick so a replica that
+        restarted (back at ``normal``) reconverges without an event."""
+        transition = self.brownout.observe(self._brownout_hot(evaluation))
+        if transition is not None:
+            old, new = transition
+            self._bump("brownout_transitions")
+            self.flight.event("fleet_brownout", old=old, new=new,
+                              rung_index=self.brownout.rung_index)
+            if rung_at_least(new, "shrink_batch") and not rung_at_least(
+                old, "shrink_batch"
+            ):
+                # crossing into actively degrading batch output is the
+                # post-mortem-worthy moment — dump the fleet state once
+                self.flight.dump(f"fleet_brownout_{new}", extra={
+                    "old": old, "new": new,
+                    "registry": self.registry.snapshot(),
+                    "slo": self.slo.snapshot() if self.slo else {},
+                })
+        if transition is not None or self.brownout.rung_index > 0:
+            self._push_brownout(self.brownout.rung)
+
+    def _push_brownout(self, rung: str) -> None:
+        """POST the current rung to every routable replica (idempotent on
+        the replica side). A replica that misses the push converges on the
+        next tick; an unreachable one is the probe loop's problem."""
+        for rep in self.registry.routable():
+            try:
+                self._post_replica(
+                    rep, "/admin/brownout", {"rung": rung},
+                    timeout=self.probe_timeout,
+                )
+            except (OSError, http.client.HTTPException):
+                pass
+
+    def _admin_brownout(self, req: dict):
+        """(code, body) for POST /admin/brownout on the ROUTER: operator
+        override of the fleet rung (``{"rung": "normal"}`` clears it).
+        The forced rung propagates immediately; the controller keeps
+        running from there, so sustained calm still walks it back."""
+        rung = req.get("rung")
+        if not isinstance(rung, str):
+            return 400, {"error": "rung must be a string"}
+        try:
+            transition = self.brownout.force(rung)
+        except ValueError as exc:
+            return 400, {"error": str(exc)}
+        if transition is not None:
+            old, new = transition
+            self._bump("brownout_transitions")
+            self.flight.event("fleet_brownout_forced", old=old, new=new)
+        self._push_brownout(self.brownout.rung)
+        return 200, self.brownout.snapshot()
+
+    def _on_tenant_evicted(self, tenant: str) -> None:
+        """TenantLedger capacity-eviction honesty (PR 18 satellite): a
+        dropped rollup row is a billing gap — count it and leave a
+        flight-recorder breadcrumb naming the tenant."""
+        self._bump("tenant_ledger_evictions")
+        self.flight.event("tenant_ledger_evicted", tenant=tenant)
 
     # ---- cross-process trace stitching
 
@@ -1237,7 +1383,8 @@ class RouterServer:
     # --------------------------------------------------------------- routing
 
     def _route(
-        self, tokens: Optional[Sequence[int]], exclude: Set[str]
+        self, tokens: Optional[Sequence[int]], exclude: Set[str],
+        tenant: Optional[str] = None,
     ) -> Optional[Replica]:
         # prefill-role replicas never take a whole request (their engine
         # rejects anything without a decode target) — the classic path and
@@ -1248,17 +1395,48 @@ class RouterServer:
         ]
         chunk = self.affinity.chunk_tokens
         affine = tokens is not None and chunk >= 1 and len(tokens) >= chunk
-        aff = self.affinity.lookup(tokens)
+        prefix_aff = self.affinity.lookup(tokens)
+        # tenant affinity (PR 18): a tenant with no prefix match still
+        # lands on its last replica — its per-tenant state there (prefix
+        # cache, warm pages) keeps paying off, and a flooding tenant's
+        # damage stays concentrated instead of smeared fleet-wide. Prefix
+        # affinity is more specific and wins when both exist. The
+        # anonymous pool is excluded: pinning all untagged traffic to one
+        # replica would defeat least-loaded balancing.
+        named = tenant is not None and tenant != "anon"
+        tenant_aff = None
+        aff = prefix_aff
+        if aff is None and named:
+            tenant_aff = self._tenant_affinity_lookup(tenant)
+            aff = tenant_aff
         rep = pick_replica(candidates, aff)
         if rep is not None:
             if affine:
-                if aff == rep.id:
+                if prefix_aff == rep.id:
                     self._bump("affinity_hits")
                 else:
                     self._bump("affinity_misses")
                 self.affinity.record(tokens, rep.id)
+            if named:
+                if tenant_aff is not None:
+                    self._bump(
+                        "tenant_affinity_hits" if tenant_aff == rep.id
+                        else "tenant_affinity_misses"
+                    )
+                self._tenant_affinity_record(tenant, rep.id)
             self._bump("routed")
         return rep
+
+    def _tenant_affinity_lookup(self, tenant: str) -> Optional[str]:
+        with self._tenant_aff_lock:
+            return self._tenant_affinity.get(tenant)
+
+    def _tenant_affinity_record(self, tenant: str, rid: str) -> None:
+        with self._tenant_aff_lock:
+            self._tenant_affinity[tenant] = rid
+            self._tenant_affinity.move_to_end(tenant)
+            while len(self._tenant_affinity) > self._tenant_affinity_capacity:
+                self._tenant_affinity.popitem(last=False)
 
     # ------------------------------------------- disaggregated dispatch
 
@@ -1412,6 +1590,10 @@ class RouterServer:
             "routable": len(routable),
             "replicas": self.registry.snapshot(),
             "rolling_reload_active": self._reload_busy.locked(),
+            # fleet brownout state: visible on the same poll every LB and
+            # operator already watches — rung changes are never silent
+            "brownout_rung": self.brownout.rung,
+            "brownout": self.brownout.snapshot(),
         }
 
     def _admin_allowed(self, handler) -> bool:
@@ -1439,6 +1621,8 @@ class RouterServer:
             self.slo.snapshot()["verdict"] if self.slo is not None
             else "disabled"
         )
+        snap["brownout_rung"] = self.brownout.rung
+        snap["qos_classes"] = self.qos.snapshot()
         return snap
 
     def _register_exports(self) -> None:
@@ -1476,6 +1660,13 @@ class RouterServer:
             ("slo_evaluations", "SLO engine evaluation passes"),
             ("slo_fast_burns", "SLO fast-burn escalations fired"),
             ("stitched_traces", "Merged fleet traces assembled"),
+            ("rejected_quota", "Requests rejected: fleet tenant quota"),
+            ("rejected_brownout", "Requests rejected: fleet brownout"),
+            ("brownout_transitions", "Fleet brownout rung transitions"),
+            ("tenant_affinity_hits", "Tenant-affinity routing hits"),
+            ("tenant_affinity_misses", "Tenant-affinity routing misses"),
+            ("tenant_ledger_evictions",
+             "Tenant rollup rows evicted at ledger capacity"),
         ):
             reg.counter_func(
                 f"router_{key}", help_text, (lambda k=key: self.stats[k])
@@ -1483,6 +1674,11 @@ class RouterServer:
         reg.gauge_func(
             "router_routable_replicas", "Replicas currently in rotation",
             lambda: len(self.registry.routable()),
+        )
+        reg.gauge_func(
+            "router_brownout_rung",
+            "Fleet brownout rung index (0=normal .. 3=suspend_batch)",
+            lambda: self.brownout.rung_index,
         )
         # bounded-ring honesty, fleet-standard name (PR 15 satellite): the
         # router's own trace truncation is as silent-failure-prone as a
@@ -1699,11 +1895,58 @@ class RouterServer:
                 "request_id": rid,
             }, headers={"X-Request-Id": rid})
             return
-        # tenant key for the cost-ledger rollup (header wins over body
-        # field; absent traffic pools under "anon")
+        # tenant key for the cost-ledger rollup and the quota/affinity
+        # planes (header wins over body field; absent traffic pools under
+        # "anon"); the QoS class rides the same precedence, normalized so
+        # an unknown class degrades to default service, never a 400
         tenant = str(
             handler.headers.get("X-Tenant-Key") or req.get("tenant") or "anon"
+        )[:64]
+        qos_name = self.qos.normalize(
+            handler.headers.get("X-QoS-Class") or req.get("qos")
         )
+        # tenant + class ride the relay BODY: _hop_body forwards dict(req)
+        # verbatim, so the replica's own admission sees the same identity
+        req = {**req, "tenant": tenant, "qos": qos_name}
+        cls = self.qos.classes[qos_name]
+        # fleet brownout, final rung: the lowest class is suspended at the
+        # front door — no replica dispatch, class-aware Retry-After
+        if rung_at_least(self.brownout.rung, "suspend_batch") and (
+            self.qos.rank(qos_name) == len(self.qos.names()) - 1
+        ):
+            self._bump("rejected_brownout")
+            handler._json(503, {
+                "error": (
+                    f"fleet brownout ({self.brownout.rung}): {qos_name} "
+                    "admission suspended; retry later"
+                ),
+                "status": "rejected", "request_id": rid,
+            }, headers={
+                "Retry-After": str(max(1, math.ceil(cls.retry_after_s))),
+                "X-Request-Id": rid,
+            })
+            return
+        # fleet-level tenant quota: the per-class bucket scaled by current
+        # routable capacity — one tenant's flood burns its own allotment
+        # before any replica queue sees it
+        quota_wait = self._fleet_buckets.take(
+            tenant, qos_name,
+            len(req.get("tokens") or ()) + int(req.get("max_new_tokens", 32)),
+            self.clock(),
+            scale=max(1, len(self.registry.routable())),
+        )
+        if quota_wait > 0:
+            self._bump("rejected_quota")
+            handler._json(429, {
+                "error": (
+                    f"tenant quota exhausted ({qos_name}); retry later"
+                ),
+                "status": "rejected", "request_id": rid,
+            }, headers={
+                "Retry-After": str(max(1, math.ceil(quota_wait))),
+                "X-Request-Id": rid,
+            })
+            return
         if req.get("stream", True):
             self._bump("streams")
             state = {"ids": [], "texts": [], "terminal": False,
@@ -1737,7 +1980,8 @@ class RouterServer:
         failovers = 0
         attach_hops = 0
         for attempt in range(self.max_attempts):
-            rep = self._route(req.get("tokens"), tried)
+            rep = self._route(req.get("tokens"), tried,
+                              tenant=req.get("tenant"))
             if rep is None:
                 break
             tried.add(rep.id)
@@ -1903,7 +2147,8 @@ class RouterServer:
                             continue  # attach hop next
                         last_error = why
                         self._bump("disagg_fallbacks")
-                rep = self._route(orig_tokens, tried)
+                rep = self._route(orig_tokens, tried,
+                                  tenant=req.get("tenant"))
                 if rep is None:
                     break
                 attempt += 1
@@ -2435,6 +2680,9 @@ class RouterServer:
         slo_hot = self.consume_slo_hot()
         if slo_hot:
             sig["slo_fast_burn"] = True
+        brownout_hot = self.brownout.rung_index > 0
+        if brownout_hot:
+            sig["brownout_rung"] = self.brownout.rung
         hot = (
             sig["queued"] / n >= self.scale_up_queue
             or (
@@ -2448,6 +2696,9 @@ class RouterServer:
             # the SLO engine's fast-burn up-signal: the declared objective
             # is dying faster than its budget — capacity now, diagnose later
             or slo_hot
+            # an engaged brownout is the fleet ALREADY degrading service:
+            # capacity is the fix, degradation is the stopgap
+            or brownout_hot
         )
         idle = (
             sig["queued"] == 0 and sig["active"] <= self.scale_down_active

@@ -204,6 +204,7 @@ class ServingServer:
                     "/generate", "/attach", "/ingest",
                     "/admin/reload", "/admin/profile",
                     "/admin/migrate", "/admin/migrate_all",
+                    "/admin/brownout",
                 ):
                     self._json(404, {"error": f"no route {self.path}"})
                     return
@@ -260,6 +261,8 @@ class ServingServer:
                         self._json(*outer._migrate(req))
                     elif self.path == "/admin/migrate_all":
                         self._json(*outer._migrate_all(req))
+                    elif self.path == "/admin/brownout":
+                        self._json(*outer._brownout(req))
                     else:
                         self._json(*outer._profile(req))
                 elif self.path == "/attach":
@@ -361,6 +364,12 @@ class ServingServer:
                 self.engine.slots.cow_copies
                 if self.engine.kv_layout == "paged" else 0
             ),
+            # overload-isolation inputs (ISSUE 18): the fleet brownout
+            # controller reads the rung it last pushed back off the same
+            # poll (convergence check), and per-class queue depths let the
+            # router see WHICH class is backed up, not just how much
+            "brownout_rung": self.engine.brownout_rung,
+            "queue_by_class": self.engine._queue.counts(),
         }
 
     def _admin_allowed(self, handler) -> bool:
@@ -453,6 +462,21 @@ class ServingServer:
         except RuntimeError as exc:
             return 409, {"error": str(exc), "state": self.engine.lifecycle.state}
         return 202, {"accepted": True, **info}
+
+    def _brownout(self, req: dict):
+        """(code, body) for POST /admin/brownout: set this replica's
+        brownout rung (``{"rung": "no_spec"}``). The fleet router's
+        controller drives this on every transition; operators can also hit
+        it directly to force or clear a rung. Idempotent — re-posting the
+        current rung is a 200 no-op."""
+        rung = req.get("rung")
+        if not isinstance(rung, str):
+            return 400, {"error": "rung must be a string"}
+        try:
+            info = self.engine.set_brownout(rung)
+        except ValueError as exc:
+            return 400, {"error": str(exc)}
+        return 200, info
 
     # -------------------------------------------- disaggregation / migration
 
@@ -654,7 +678,8 @@ class ServingServer:
     # -------------------------------------------------------------- request
 
     def _submit(self, req: dict, request_id: Optional[str] = None,
-                trace_hop: Optional[int] = None):
+                trace_hop: Optional[int] = None,
+                tenant: Optional[str] = None, qos: Optional[str] = None):
         if "tokens" in req:
             ids = [int(t) for t in req["tokens"]]
         else:
@@ -669,6 +694,8 @@ class ServingServer:
                 str(req["prefill_to"]) if req.get("prefill_to") else None
             ),
             trace_hop=trace_hop,
+            tenant=str(tenant or req.get("tenant") or "anon"),
+            qos=qos if qos is not None else req.get("qos"),
         )
 
     @staticmethod
@@ -690,8 +717,14 @@ class ServingServer:
         # every response carries it back as X-Request-Id
         rid_in = handler.headers.get("X-Request-Id") or req.get("request_id")
         try:
-            handle = self._submit(req, request_id=rid_in,
-                                  trace_hop=self._trace_hop_of(handler))
+            handle = self._submit(
+                req, request_id=rid_in,
+                trace_hop=self._trace_hop_of(handler),
+                # header wins over body field, same precedence as the
+                # request id — the router forwards both in the relay body
+                tenant=handler.headers.get("X-Tenant-Key"),
+                qos=handler.headers.get("X-QoS-Class"),
+            )
         except (TypeError, ValueError) as exc:
             # ill-typed field VALUES ({"timeout": "abc"}) are the client's
             # error — 400, not a dropped connection with a server traceback
@@ -702,8 +735,14 @@ class ServingServer:
             if handle.retryable:
                 # drain / shed / backpressure: honest fast failure the
                 # client should retry elsewhere — Retry-After sized by the
-                # engine (remaining drain window, or a beat for the queue)
-                code = 429 if "queue full" in (handle.error or "") else 503
+                # engine (remaining drain window, or a beat for the queue).
+                # Quota exhaustion and brownout suspension are 429s too:
+                # the CLIENT is over its allotment, the replica is fine
+                err = handle.error or ""
+                code = 429 if (
+                    "queue full" in err or "quota" in err
+                    or "brownout" in err
+                ) else 503
                 handler._json(
                     code,
                     {"error": handle.error, "status": handle.status,
@@ -766,6 +805,13 @@ class ServingServer:
         decoder = StreamDecoder(self.tokenizer)
         pieces: list = []
         eos = self.engine.eos_token_id
+        # a live SSE consumer is draining the event queue from here on:
+        # arm the per-handle emit-buffer bound so a consumer that stops
+        # reading (stalled client) retires the stream instead of growing
+        # the queue without limit
+        handle.consumer_attached = True
+        chaos = self.engine._chaos
+        events_out = 0
         try:
             # the EOS token is swallowed, not break-ed on: the loop must end
             # on the 'done' event so handle.status is terminal by the time
@@ -785,6 +831,14 @@ class ServingServer:
                 kind, token = event
                 if kind != "token":
                     break
+                events_out += 1
+                if chaos is not None:
+                    # slow_client fault: THIS consumer stops draining for
+                    # ``duration`` seconds mid-stream — the engine keeps
+                    # decoding into the bounded emit buffer meanwhile
+                    stall = chaos.client_stall_s(events_out)
+                    if stall > 0:
+                        time.sleep(stall)
                 if eos is not None and token == eos:
                     continue
                 piece = decoder.push(token)
